@@ -1,0 +1,32 @@
+"""distributed_training_tpu — a TPU-native distributed training framework.
+
+A ground-up JAX/XLA/Pallas re-design of the capabilities of the reference
+repo ``erfanMhi/distributed_training`` (a Hydra-driven torch DDP/FSDP
+trainer; see SURVEY.md): config-driven training with pluggable parallelism
+strategies, deterministic per-process data sharding, checkpoint/resume,
+a pedagogical "DDP from collective primitives" playground, and pod-level
+launch automation — expressed TPU-first:
+
+- one jitted train step whose parallelism is a *sharding layout* over a
+  ``jax.sharding.Mesh`` (axes ``dp``/``fsdp``/``tp``/``sp``/``ep``), with
+  XLA-compiled collectives over ICI/DCN replacing NCCL/Gloo
+  (reference: src/distributed_trainer.py:61, src/dist_strategy/*),
+- ``jax.distributed`` rendezvous replacing torchrun
+  (reference: infrastructure/nebius/cluster/scripts/cloud-init.tftpl:61-77),
+- Orbax sharded checkpointing replacing ``torch.save`` snapshots
+  (reference: src/dist_strategy/{ddp,fsdp}_strategy.py),
+- Pallas kernels (flash attention) + ring-attention sequence parallelism
+  for the long-context path the transformer targets require.
+"""
+
+__version__ = "0.1.0"
+
+from distributed_training_tpu.config import (  # noqa: F401
+    Config,
+    load_config,
+)
+from distributed_training_tpu.runtime import (  # noqa: F401
+    MeshSpec,
+    Runtime,
+    initialize_runtime,
+)

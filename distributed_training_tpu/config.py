@@ -1,0 +1,299 @@
+"""Config layer: YAML composition + dotted CLI overrides → typed dataclasses.
+
+TPU-native replacement for the reference's Hydra setup
+(reference: conf/config.yaml:1-14, src/distributed_trainer.py:29-39,243-258).
+We keep the same user-facing model — a composition root YAML with
+``defaults`` groups (``model``, ``train``, plus ``mesh``) and
+``key.path=value`` command-line overrides — but implement it as a small,
+dependency-free loader so the framework controls run-dir/chdir behavior
+explicitly (the reference's Hydra chdir breaks resume; SURVEY.md §8 B2).
+
+Grammar:
+- ``group=name``      swap a defaults-group file (e.g. ``model=gpt2_125m``)
+- ``a.b.c=value``     set a leaf (value parsed with yaml.safe_load)
+- ``+a.b.c=value``    add a new leaf that need not already exist
+"""
+
+from __future__ import annotations
+
+import copy
+import dataclasses
+import os
+from dataclasses import dataclass, field
+from typing import Any
+
+import yaml
+
+
+class ConfigError(ValueError):
+    """Raised for malformed config files or overrides."""
+
+
+# ---------------------------------------------------------------------------
+# Typed config schema
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class TrainConfig:
+    """Training knobs; field-for-field superset of the reference's
+    ``TrainingConfig`` (reference: src/distributed_trainer.py:29-39,
+    conf/train/default.yaml)."""
+
+    batch_size: int = 32          # per-process batch size, as in the reference
+    total_epochs: int = 10
+    save_every: int = 2           # epochs between checkpoints
+    snapshot_path: str = "checkpoints"  # absolute-anchored at load (fixes B2)
+    dataset_size: int = 2048
+    learning_rate: float = 1e-3
+    device: str = "auto"          # "auto" | "tpu" | "cpu"
+    parallel_strategy: str = "ddp"  # "ddp" | "fsdp" (+ framework extensions)
+    seed: int = 42
+    optimizer: str = "sgd"        # "sgd" | "adamw"
+    weight_decay: float = 0.0
+    b1: float = 0.9
+    b2: float = 0.95
+    grad_clip_norm: float = 0.0   # 0 disables
+    warmup_steps: int = 0
+    lr_schedule: str = "constant"  # "constant" | "cosine"
+    total_steps: int = 0          # 0 → derived from epochs * steps/epoch
+    log_every: int = 10           # steps between metric lines
+    dtype: str = "float32"        # compute dtype: "float32" | "bfloat16"
+    param_dtype: str = "float32"
+    remat: bool = False           # gradient checkpointing for big models
+    loss: str = "auto"            # "auto" | "mse" | "xent" | "prob_xent"
+    dataset: str = "synthetic"    # data source name
+    shuffle: bool = True
+    drop_last: bool = False
+    max_steps_per_epoch: int = 0  # 0 → whole shard (test/bench aid)
+    nan_guard: bool = False       # skip+log non-finite update steps
+    divergence_check_every: int = 0  # steps; 0 disables replica-drift check
+    profile_dir: str = ""         # non-empty → jax.profiler traces here
+
+
+@dataclass
+class MeshConfig:
+    """Logical mesh shape. ``-1`` on exactly one axis means "fill with the
+    remaining devices". Axes: dp (pure data parallel, outermost / DCN),
+    fsdp (param sharding, ICI), tp (tensor/model), sp (sequence/context),
+    ep (expert; folded over fsdp×dp when used), pp (pipeline stages)."""
+
+    dp: int = -1
+    fsdp: int = 1
+    tp: int = 1
+    sp: int = 1
+    pp: int = 1
+
+
+@dataclass
+class ModelConfig:
+    """Model selection + hyperparameters. ``name`` picks the family from the
+    registry (models/registry.py); remaining fields are family-specific and
+    carried as an open dict so YAML stays the source of truth."""
+
+    name: str = "mlp"
+    kwargs: dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class RunConfig:
+    """Run environment: output dir, logging."""
+
+    output_dir: str = "outputs"
+    log_level: str = "INFO"
+    log_file: str = "training.log"
+    experiment_name: str = "default"
+
+
+@dataclass
+class Config:
+    train: TrainConfig = field(default_factory=TrainConfig)
+    mesh: MeshConfig = field(default_factory=MeshConfig)
+    model: ModelConfig = field(default_factory=ModelConfig)
+    run: RunConfig = field(default_factory=RunConfig)
+
+    def to_dict(self) -> dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+# ---------------------------------------------------------------------------
+# YAML composition
+# ---------------------------------------------------------------------------
+
+
+def _load_yaml(path: str) -> dict[str, Any]:
+    if not os.path.exists(path):
+        raise ConfigError(f"config file not found: {path}")
+    with open(path) as f:
+        data = yaml.safe_load(f) or {}
+    if not isinstance(data, dict):
+        raise ConfigError(f"top level of {path} must be a mapping")
+    return data
+
+
+def _deep_merge(base: dict[str, Any], over: dict[str, Any]) -> dict[str, Any]:
+    out = dict(base)
+    for k, v in over.items():
+        if isinstance(v, dict) and isinstance(out.get(k), dict):
+            out[k] = _deep_merge(out[k], v)
+        else:
+            out[k] = v
+    return out
+
+
+def _set_path(tree: dict[str, Any], dotted: str, value: Any,
+              allow_new: bool) -> None:
+    keys = dotted.split(".")
+    node = tree
+    for k in keys[:-1]:
+        if k in node and not isinstance(node[k], dict):
+            raise ConfigError(
+                f"override path '{dotted}': '{k}' is a value, not a group")
+        if k not in node:
+            if not allow_new:
+                raise ConfigError(
+                    f"override path '{dotted}': unknown key '{k}' "
+                    f"(use +{dotted}=... to add new keys)")
+            node[k] = {}
+        node = node[k]
+    leaf = keys[-1]
+    if not allow_new and leaf not in node:
+        raise ConfigError(
+            f"override path '{dotted}': unknown key '{leaf}' "
+            f"(use +{dotted}=... to add new keys)")
+    node[leaf] = value
+
+
+def compose(config_dir: str, config_name: str = "config",
+            overrides: list[str] | None = None) -> dict[str, Any]:
+    """Compose the raw config dict: root YAML + defaults groups + overrides.
+
+    Mirrors the reference's Hydra composition of conf/config.yaml's
+    ``defaults: [model: default, train: default]`` (conf/config.yaml:1-4)
+    without the chdir side effects.
+    """
+    overrides = list(overrides or [])
+    root = _load_yaml(os.path.join(config_dir, f"{config_name}.yaml"))
+    defaults = root.pop("defaults", [])
+
+    # group=name overrides replace default group selections before loading
+    group_over: dict[str, str] = {}
+    leaf_over: list[tuple[str, str, bool]] = []
+    for ov in overrides:
+        if "=" not in ov:
+            raise ConfigError(f"override '{ov}' must be key=value")
+        key, val = ov.split("=", 1)
+        allow_new = key.startswith("+")
+        key = key.lstrip("+")
+        if "." not in key and os.path.isdir(os.path.join(config_dir, key)):
+            group_over[key] = val
+        else:
+            leaf_over.append((key, val, allow_new))
+
+    selections: list[tuple[str, str]] = []
+    for entry in defaults:
+        if isinstance(entry, str):  # e.g. "_self_"
+            continue
+        if not isinstance(entry, dict) or len(entry) != 1:
+            raise ConfigError(f"bad defaults entry: {entry!r}")
+        (group, name), = entry.items()
+        selections.append((group, group_over.pop(group, name)))
+    selections.extend(group_over.items())
+
+    tree: dict[str, Any] = {}
+    for group, name in selections:
+        group_file = os.path.join(config_dir, group, f"{name}.yaml")
+        tree = _deep_merge(tree, {group: _load_yaml(group_file)})
+
+    tree = _deep_merge(tree, root)
+
+    for key, val, allow_new in leaf_over:
+        _set_path(tree, key, yaml.safe_load(val), allow_new)
+    return tree
+
+
+# ---------------------------------------------------------------------------
+# dict → dataclass
+# ---------------------------------------------------------------------------
+
+
+def _build_dataclass(cls: type, data: dict[str, Any], path: str) -> Any:
+    import typing
+    hints = typing.get_type_hints(cls)  # resolve string annotations
+    fields = {f.name: f for f in dataclasses.fields(cls)}
+    kwargs: dict[str, Any] = {}
+    extra: dict[str, Any] = {}
+    for k, v in data.items():
+        if k in fields:
+            ftype = hints.get(k, fields[k].type)
+            if dataclasses.is_dataclass(ftype) and isinstance(v, dict):
+                v = _build_dataclass(ftype, v, f"{path}.{k}")
+            kwargs[k] = v
+        else:
+            extra[k] = v
+    if extra:
+        if "kwargs" in fields:  # open-schema dataclasses (ModelConfig)
+            kwargs.setdefault("kwargs", {})
+            kwargs["kwargs"] = {**extra, **kwargs["kwargs"]}
+        else:
+            raise ConfigError(
+                f"unknown key(s) {sorted(extra)} under '{path}' for "
+                f"{cls.__name__}")
+    return cls(**kwargs)
+
+
+def config_from_dict(tree: dict[str, Any]) -> Config:
+    cfg = Config(
+        train=_build_dataclass(TrainConfig, tree.get("train", {}), "train"),
+        mesh=_build_dataclass(MeshConfig, tree.get("mesh", {}), "mesh"),
+        model=_build_dataclass(ModelConfig, tree.get("model", {}), "model"),
+        run=_build_dataclass(RunConfig, tree.get("run", {}), "run"),
+    )
+    return cfg
+
+
+def load_config(config_dir: str | None = None, config_name: str = "config",
+                overrides: list[str] | None = None) -> Config:
+    """Load the typed framework config.
+
+    ``config_dir`` defaults to ``<repo_root>/conf`` (parity with the
+    reference's ``@hydra.main(config_path="../conf")``,
+    src/distributed_trainer.py:243).
+    """
+    if config_dir is None:
+        config_dir = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "conf")
+    tree = compose(config_dir, config_name, overrides)
+    cfg = config_from_dict(tree)
+    # Anchor snapshot_path against output_dir at load time (not at save
+    # time, and with no per-run chdir) so restarts launched the same way
+    # find the previous snapshot — the reference's relative "snapshot.pt"
+    # + Hydra per-run chdir made resume impossible (SURVEY.md §8 B2).
+    # A relative output_dir still depends on the launch cwd; launchers
+    # that need cwd-independence should set an absolute run.output_dir.
+    if cfg.train.snapshot_path and not os.path.isabs(cfg.train.snapshot_path):
+        cfg.train.snapshot_path = os.path.abspath(
+            os.path.join(cfg.run.output_dir, cfg.run.experiment_name,
+                         cfg.train.snapshot_path))
+    return cfg
+
+
+def save_resolved(cfg: Config, path: str) -> None:
+    """Write the resolved config next to run outputs for reproducibility."""
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "w") as f:
+        yaml.safe_dump(cfg.to_dict(), f, sort_keys=False)
+
+
+def override_config(cfg: Config, **groups: dict[str, Any]) -> Config:
+    """Return a copy of ``cfg`` with dataclass-level replacements applied
+    (programmatic analogue of CLI overrides, used by tests/benches)."""
+    cfg = copy.deepcopy(cfg)
+    for group, repl in groups.items():
+        sub = getattr(cfg, group)
+        for k, v in repl.items():
+            if not hasattr(sub, k):
+                raise ConfigError(f"unknown field {group}.{k}")
+            setattr(sub, k, v)
+    return cfg

@@ -1,0 +1,268 @@
+"""Runtime layer: process environment + device mesh.
+
+TPU-native replacement for the reference's ``DistributedEnvironment``
+(reference: src/distributed_trainer.py:42-70) and its NCCL/Gloo process-group
+bootstrap. Where the reference reads torchrun-injected RANK/LOCAL_RANK/
+WORLD_SIZE and calls ``init_process_group`` (src/distributed_trainer.py:48-62),
+here multi-host rendezvous is ``jax.distributed.initialize`` (auto-detected on
+Cloud TPU pods) and the unit of parallelism is not a process rank but a
+``jax.sharding.Mesh`` over all addressable devices, with logical axes:
+
+- ``dp``   pure data parallelism (outermost; rides DCN across slices)
+- ``fsdp`` parameter sharding (ZeRO-3 analogue; rides ICI)
+- ``tp``   tensor/model parallelism (innermost, highest-bandwidth ICI)
+- ``sp``   sequence/context parallelism (ring attention)
+- ``pp``   pipeline stages
+
+Collectives are never called imperatively at this layer: XLA emits
+psum/all-gather/reduce-scatter/ppermute from sharding annotations on the
+jitted train step (the compiled-collective counterpart of NCCL; SURVEY.md
+§2.2/§2.4).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import math
+import os
+from dataclasses import dataclass
+
+import jax
+import numpy as np
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from distributed_training_tpu.config import Config, MeshConfig
+
+logger = logging.getLogger(__name__)
+
+# Canonical mesh axis order, outermost (slowest-varying, DCN-adjacent)
+# to innermost (fastest ICI links).
+AXIS_DP = "dp"
+AXIS_FSDP = "fsdp"
+AXIS_TP = "tp"
+AXIS_SP = "sp"
+AXIS_PP = "pp"
+MESH_AXES = (AXIS_PP, AXIS_DP, AXIS_FSDP, AXIS_SP, AXIS_TP)
+
+# The batch dimension is sharded over both data-parallel-like axes: FSDP
+# shards data as well as params (torch-FSDP semantics, reference
+# src/dist_strategy/fsdp_strategy.py), and dp adds pure replication groups.
+BATCH_AXES = (AXIS_DP, AXIS_FSDP)
+
+
+class RuntimeError_(RuntimeError):
+    pass
+
+
+@dataclass(frozen=True)
+class MeshSpec:
+    """Resolved (all-positive) mesh shape."""
+
+    pp: int = 1
+    dp: int = 1
+    fsdp: int = 1
+    sp: int = 1
+    tp: int = 1
+
+    @property
+    def total(self) -> int:
+        return self.pp * self.dp * self.fsdp * self.sp * self.tp
+
+    def as_dict(self) -> dict[str, int]:
+        return {a: getattr(self, a) for a in MESH_AXES}
+
+    @staticmethod
+    def resolve(cfg: MeshConfig, num_devices: int) -> "MeshSpec":
+        """Fill at most one ``-1`` axis with the remaining device count."""
+        sizes = {a: getattr(cfg, a) for a in MESH_AXES}
+        bad = [a for a, s in sizes.items() if s != -1 and s < 1]
+        if bad:
+            raise RuntimeError_(
+                f"mesh axis size must be -1 or >= 1; got "
+                f"{ {a: sizes[a] for a in bad} }")
+        wild = [a for a, s in sizes.items() if s == -1]
+        if len(wild) > 1:
+            raise RuntimeError_(f"at most one mesh axis may be -1, got {wild}")
+        fixed = math.prod(s for s in sizes.values() if s != -1)
+        if wild:
+            if num_devices % fixed != 0:
+                raise RuntimeError_(
+                    f"fixed mesh axes {sizes} (product {fixed}) do not divide "
+                    f"device count {num_devices}")
+            sizes[wild[0]] = num_devices // fixed
+        elif fixed != num_devices:
+            raise RuntimeError_(
+                f"mesh {sizes} needs {fixed} devices but {num_devices} are "
+                f"available")
+        return MeshSpec(**sizes)
+
+
+def build_mesh(spec: MeshSpec, devices: list | None = None) -> Mesh:
+    """Build the device mesh.
+
+    Uses ``mesh_utils.create_device_mesh`` so logical axes map onto the
+    physical ICI torus sensibly (innermost logical axis → nearest
+    neighbours); falls back to a plain reshape for platforms where the
+    topology helper is unsupported (CPU fake devices).
+    """
+    devices = list(jax.devices()) if devices is None else list(devices)
+    shape = tuple(spec.as_dict()[a] for a in MESH_AXES)
+    if math.prod(shape) != len(devices):
+        raise RuntimeError_(
+            f"mesh shape {shape} != device count {len(devices)}")
+    try:
+        dev_array = mesh_utils.create_device_mesh(
+            shape, devices=devices, allow_split_physical_axes=True)
+    except Exception:  # pragma: no cover - topology helper unavailable
+        dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, MESH_AXES)
+
+
+@dataclass
+class Runtime:
+    """Everything a training program needs to know about where it runs.
+
+    Interface parity with ``DistributedEnvironment`` (reference:
+    src/distributed_trainer.py:42-70): ``process_index`` ↔ global rank,
+    ``process_count`` ↔ world size (in units of hosts, as is natural on
+    TPU where one process drives all local chips), ``is_coordinator`` ↔
+    rank-0 checks used to gate logging/checkpointing.
+    """
+
+    mesh: Mesh
+    spec: MeshSpec
+    platform: str
+    process_index: int
+    process_count: int
+
+    @property
+    def is_coordinator(self) -> bool:
+        return self.process_index == 0
+
+    @property
+    def num_devices(self) -> int:
+        return self.mesh.devices.size
+
+    @property
+    def local_device_count(self) -> int:
+        return jax.local_device_count()
+
+    # -- shardings ---------------------------------------------------------
+
+    def sharding(self, *spec) -> NamedSharding:
+        return NamedSharding(self.mesh, P(*spec))
+
+    @property
+    def replicated(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P())
+
+    @property
+    def batch_sharding(self) -> NamedSharding:
+        """Batch split across all data-parallel-like axes (dp, fsdp)."""
+        return NamedSharding(self.mesh, P(BATCH_AXES))
+
+    @property
+    def data_shard_count(self) -> int:
+        """Number of distinct data shards (≅ reference world_size for the
+        DistributedSampler arithmetic)."""
+        return self.spec.dp * self.spec.fsdp
+
+    def describe(self) -> str:
+        return (f"platform={self.platform} devices={self.num_devices} "
+                f"processes={self.process_count} mesh={self.spec.as_dict()}")
+
+
+def _maybe_init_distributed() -> None:
+    """Multi-host rendezvous.
+
+    On Cloud TPU pods ``jax.distributed.initialize()`` auto-detects
+    coordinator/process_id from the TPU metadata server (replacing the
+    reference's torchrun + MASTER_ADDR:29500 rendezvous and the worker
+    nc-probe loop, cloud-init.tftpl:18-32,61-77). Off-pod multi-process
+    runs configure it with env vars; single-process runs skip it.
+    """
+    # NOTE: must not touch jax.devices()/process_count() before
+    # jax.distributed.initialize() — that would initialize the local
+    # backend and break pod formation. Decide from env vars only.
+    coord = os.environ.get("DTT_COORDINATOR")
+    nproc = os.environ.get("DTT_NUM_PROCESSES")
+    pid = os.environ.get("DTT_PROCESS_ID")
+    try:
+        if coord and nproc and pid:
+            jax.distributed.initialize(
+                coordinator_address=coord,
+                num_processes=int(nproc),
+                process_id=int(pid),
+            )
+        elif os.environ.get("DTT_AUTO_DISTRIBUTED", "0") == "1":
+            # TPU pod: everything auto-detected from the metadata server.
+            jax.distributed.initialize()
+    except RuntimeError as e:
+        if "already" in str(e).lower():
+            logger.info("jax.distributed already initialized by launcher")
+        else:
+            raise
+
+
+def initialize_runtime(cfg: Config) -> Runtime:
+    """Build the runtime: rendezvous (if multi-host), pick devices per
+    ``cfg.train.device`` ("auto" prefers TPU, parity with reference
+    device="auto" → cuda-if-available, src/distributed_trainer.py:53-58),
+    resolve the mesh shape, and construct the mesh."""
+    _maybe_init_distributed()
+
+    device_pref = cfg.train.device
+    if device_pref in ("auto", ""):
+        devices = jax.devices()
+    else:
+        try:
+            devices = jax.devices(device_pref)
+        except RuntimeError as e:
+            raise RuntimeError_(
+                f"requested device '{device_pref}' unavailable: {e}") from e
+
+    spec = MeshSpec.resolve(cfg.mesh, len(devices))
+    mesh = build_mesh(spec, devices)
+    rt = Runtime(
+        mesh=mesh,
+        spec=spec,
+        platform=devices[0].platform,
+        process_index=jax.process_index(),
+        process_count=jax.process_count(),
+    )
+    logger.info("runtime initialized: %s", rt.describe())
+    return rt
+
+
+def runtime_for_mesh(mesh: Mesh) -> Runtime:
+    """Wrap an externally-built mesh (tests, dryruns) in a Runtime."""
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    spec = MeshSpec(**{a: sizes.get(a, 1) for a in MESH_AXES})
+    return Runtime(
+        mesh=mesh,
+        spec=spec,
+        platform=mesh.devices.flat[0].platform,
+        process_index=jax.process_index(),
+        process_count=jax.process_count(),
+    )
+
+
+def fake_cpu_runtime(num_devices: int = 8, **axis_sizes: int) -> Runtime:
+    """Test/dryrun helper: a Runtime over CPU fake devices.
+
+    The CPU analogue of the reference's Gloo fallback
+    (src/distributed_trainer.py:55-61) — requires the process to have been
+    started with ``XLA_FLAGS=--xla_force_host_platform_device_count=N``
+    (tests/conftest.py does this).
+    """
+    devices = jax.devices("cpu")[:num_devices]
+    if len(devices) < num_devices:
+        raise RuntimeError_(
+            f"need {num_devices} cpu devices, have {len(devices)}; set "
+            f"XLA_FLAGS=--xla_force_host_platform_device_count={num_devices}")
+    cfg = MeshConfig(**{**{a: 1 for a in MESH_AXES}, "dp": -1, **axis_sizes})
+    spec = MeshSpec.resolve(cfg, num_devices)
+    return dataclasses.replace(
+        runtime_for_mesh(build_mesh(spec, devices)), platform="cpu")

@@ -1,0 +1,40 @@
+"""Test fixture: simulate an 8-device TPU slice on CPU.
+
+The CPU analogue of the reference's Gloo/CPU cluster simulation
+(reference: src/distributed_trainer.py:55-61, src/playground/ddp_script.py:
+230-234): all sharding/collective tests run on 8 fake CPU devices so the
+full multi-chip path is exercised without TPU hardware. Must run before
+jax is imported anywhere.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+# Keep test compiles fast & deterministic.
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+# Site customizations may pin jax_platforms to the hardware plugin at
+# interpreter startup, overriding the env var — force CPU back on.
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def cpu8():
+    """Session-wide 8-device CPU runtime with a pure-DP mesh."""
+    from distributed_training_tpu.runtime import fake_cpu_runtime
+    return fake_cpu_runtime(8)
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _check_devices():
+    assert jax.device_count() >= 8, (
+        "conftest failed to fake 8 cpu devices; got "
+        f"{jax.device_count()}")

@@ -1,0 +1,99 @@
+"""Config layer tests: composition, overrides, typed resolution.
+
+Covers the behaviors the reference delegated to Hydra
+(reference: conf/config.yaml:1-14, src/distributed_trainer.py:243-258).
+"""
+
+import os
+
+import pytest
+
+from distributed_training_tpu.config import (
+    Config, ConfigError, compose, config_from_dict, load_config,
+    override_config, save_resolved,
+)
+
+CONF = os.path.join(os.path.dirname(os.path.dirname(__file__)), "conf")
+
+
+def test_defaults_match_reference():
+    cfg = load_config(CONF)
+    # Parity targets: reference conf/train/default.yaml + conf/model/default.yaml
+    assert cfg.train.batch_size == 32
+    assert cfg.train.total_epochs == 10
+    assert cfg.train.save_every == 2
+    assert cfg.train.dataset_size == 2048
+    assert cfg.train.learning_rate == pytest.approx(1e-3)
+    assert cfg.train.parallel_strategy == "ddp"
+    assert cfg.model.name == "mlp"
+    assert cfg.model.kwargs["input_size"] == 20
+    assert cfg.model.kwargs["output_size"] == 1
+
+
+def test_snapshot_path_is_anchored():
+    # Fixes reference bug B2 (relative snapshot path + chdir kills resume).
+    cfg = load_config(CONF)
+    assert os.path.isabs(cfg.train.snapshot_path)
+
+
+def test_leaf_overrides():
+    cfg = load_config(CONF, overrides=[
+        "train.batch_size=64",
+        "train.learning_rate=0.01",
+        "mesh.fsdp=4",
+        "mesh.dp=2",
+    ])
+    assert cfg.train.batch_size == 64
+    assert cfg.train.learning_rate == pytest.approx(0.01)
+    assert cfg.mesh.fsdp == 4
+    assert cfg.mesh.dp == 2
+
+
+def test_unknown_leaf_rejected_without_plus():
+    with pytest.raises(ConfigError):
+        load_config(CONF, overrides=["train.nope=1"])
+
+
+def test_plus_adds_new_key():
+    tree = compose(CONF, overrides=["+model.n_layer=12"])
+    assert tree["model"]["n_layer"] == 12
+    cfg = config_from_dict(tree)
+    assert cfg.model.kwargs["n_layer"] == 12
+
+
+def test_group_swap(tmp_path):
+    (tmp_path / "model").mkdir()
+    (tmp_path / "train").mkdir()
+    (tmp_path / "mesh").mkdir()
+    (tmp_path / "config.yaml").write_text(
+        "defaults:\n  - model: default\n  - train: default\n")
+    (tmp_path / "model" / "default.yaml").write_text("name: mlp\n")
+    (tmp_path / "model" / "big.yaml").write_text("name: transformer\n")
+    (tmp_path / "train" / "default.yaml").write_text("batch_size: 8\n")
+    cfg = load_config(str(tmp_path), overrides=["model=big"])
+    assert cfg.model.name == "transformer"
+
+
+def test_roundtrip_save(tmp_path):
+    cfg = load_config(CONF)
+    path = str(tmp_path / "resolved.yaml")
+    save_resolved(cfg, path)
+    assert os.path.exists(path)
+
+
+def test_override_config_helper():
+    cfg = Config()
+    cfg2 = override_config(cfg, train={"batch_size": 4})
+    assert cfg2.train.batch_size == 4
+    assert cfg.train.batch_size == 32  # original untouched
+    with pytest.raises(ConfigError):
+        override_config(cfg, train={"bogus": 1})
+
+
+def test_override_scalar_intermediate_rejected():
+    # Regression: 'train.batch_size.typo=1' must not clobber batch_size
+    # with a dict.
+    with pytest.raises(ConfigError):
+        load_config(CONF, overrides=["train.batch_size.typo=1"])
+    with pytest.raises(ConfigError):
+        load_config(CONF, overrides=["+train.batch_size.typo=1"])
