@@ -1,0 +1,23 @@
+"""Data layer: datasets, deterministic distributed sampling, batch assembly.
+
+TPU-native replacement for the reference's ``MyTrainDataset`` +
+``DataLoader(DistributedSampler)`` stack (reference: src/data_utils.py:7-16,
+src/distributed_trainer.py:204-211). Sampling semantics (shard-by-rank,
+epoch-seeded reshuffle, wrap-padding to a world-size multiple) are preserved;
+batch assembly produces globally-sharded ``jax.Array``s laid out for the
+mesh's data axes instead of per-rank host tensors.
+"""
+
+from distributed_training_tpu.data.datasets import (  # noqa: F401
+    ArrayDataset,
+    Dataset,
+    SyntheticLMDataset,
+    SyntheticRegressionDataset,
+    build_dataset,
+)
+from distributed_training_tpu.data.sampler import (  # noqa: F401
+    DistributedShardSampler,
+)
+from distributed_training_tpu.data.loader import (  # noqa: F401
+    ShardedDataLoader,
+)

@@ -1,0 +1,150 @@
+"""Datasets: map-style, NumPy-backed, deterministic.
+
+The reference's datasets are tiny synthetic tensors created eagerly on the
+host (``MyTrainDataset``: ``size`` pairs of ``(rand(20), rand(1))``,
+src/data_utils.py:7-16; the playground's ``DummyDataset``:
+``(randn(10), randn(1))``, src/playground/ddp_script.py:26-36). We keep that
+map-style contract — ``len(ds)`` and ``ds[i] -> dict of arrays`` — because
+the DistributedSampler arithmetic is defined over it, but store columnar
+NumPy so a whole index-batch gathers in one fancy-index op.
+"""
+
+from __future__ import annotations
+
+from typing import Mapping, Protocol
+
+import numpy as np
+
+
+class Dataset(Protocol):
+    """Map-style dataset: columnar access by index array."""
+
+    def __len__(self) -> int: ...
+
+    def batch(self, indices: np.ndarray) -> Mapping[str, np.ndarray]:
+        """Gather rows for ``indices`` into a dict of stacked arrays."""
+        ...
+
+
+class ArrayDataset:
+    """Columnar in-memory dataset over named NumPy arrays."""
+
+    def __init__(self, **columns: np.ndarray):
+        if not columns:
+            raise ValueError("ArrayDataset needs at least one column")
+        sizes = {k: len(v) for k, v in columns.items()}
+        if len(set(sizes.values())) != 1:
+            raise ValueError(f"column length mismatch: {sizes}")
+        self.columns = dict(columns)
+        self._size = next(iter(sizes.values()))
+
+    def __len__(self) -> int:
+        return self._size
+
+    def batch(self, indices: np.ndarray) -> dict[str, np.ndarray]:
+        return {k: v[indices] for k, v in self.columns.items()}
+
+
+class SyntheticRegressionDataset(ArrayDataset):
+    """Parity with the reference's synthetic data distributions.
+
+    ``kind="uniform"`` reproduces ``MyTrainDataset`` (rand(in_dim), rand(1);
+    src/data_utils.py:10); ``kind="normal"`` reproduces the playground's
+    ``DummyDataset`` (randn; src/playground/ddp_script.py:30-32) whose
+    targets carry a learnable linear signal via the loss (MSE). Data is
+    generated once, seeded, identical on every process — the TPU analogue
+    of every rank building the same dataset then sampling its shard.
+    """
+
+    def __init__(self, size: int = 2048, in_dim: int = 20, out_dim: int = 1,
+                 seed: int = 0, kind: str = "uniform"):
+        rng = np.random.default_rng(seed)
+        if kind == "uniform":
+            x = rng.random((size, in_dim), dtype=np.float32)
+            y = rng.random((size, out_dim), dtype=np.float32)
+        elif kind == "normal":
+            x = rng.standard_normal((size, in_dim), dtype=np.float32)
+            y = rng.standard_normal((size, out_dim), dtype=np.float32)
+        elif kind == "linear":
+            # A solvable regression task (for convergence tests): y = xW + b
+            # + noise. The reference's default task is degenerate (SURVEY.md
+            # §8 B5); this kind exists so convergence is actually testable.
+            w = rng.standard_normal((in_dim, out_dim), dtype=np.float32)
+            b = rng.standard_normal((out_dim,), dtype=np.float32)
+            x = rng.standard_normal((size, in_dim), dtype=np.float32)
+            noise = 0.01 * rng.standard_normal((size, out_dim),
+                                               dtype=np.float32)
+            y = x @ w + b + noise
+        else:
+            raise ValueError(f"unknown kind: {kind}")
+        super().__init__(x=x, y=y)
+
+
+class SyntheticLMDataset(ArrayDataset):
+    """Synthetic language-model corpus: random token sequences with a
+    next-token structure (each row is ``seq_len + 1`` tokens; the model sees
+    ``tokens[:-1]`` and predicts ``tokens[1:]``). Stands in for the
+    OpenWebText shard of BASELINE.json config 3 in tests/benches."""
+
+    def __init__(self, size: int = 1024, seq_len: int = 128,
+                 vocab_size: int = 50257, seed: int = 0):
+        rng = np.random.default_rng(seed)
+        tokens = rng.integers(0, vocab_size, (size, seq_len + 1),
+                              dtype=np.int32)
+        super().__init__(tokens=tokens)
+        self.seq_len = seq_len
+        self.vocab_size = vocab_size
+
+
+class SyntheticImageDataset(ArrayDataset):
+    """Synthetic labelled images (CIFAR-10-shaped by default) for the
+    ResNet config of BASELINE.json when no real data is present."""
+
+    def __init__(self, size: int = 1024, height: int = 32, width: int = 32,
+                 channels: int = 3, num_classes: int = 10, seed: int = 0):
+        rng = np.random.default_rng(seed)
+        x = rng.standard_normal((size, height, width, channels),
+                                dtype=np.float32)
+        y = rng.integers(0, num_classes, (size,), dtype=np.int32)
+        super().__init__(x=x, y=y)
+        self.num_classes = num_classes
+
+
+class MemmapTokenDataset:
+    """Token corpus over a flat binary file of token ids (np.memmap), the
+    standard 'tokenized shard on shared storage' layout for real LM
+    pretraining. Rows are non-overlapping windows of ``seq_len + 1``."""
+
+    def __init__(self, path: str, seq_len: int, dtype: str = "uint16"):
+        self._data = np.memmap(path, dtype=dtype, mode="r")
+        self.seq_len = seq_len
+        self._size = (len(self._data) - 1) // seq_len
+        if self._size <= 0:
+            raise ValueError(f"{path} too small for seq_len={seq_len}")
+
+    def __len__(self) -> int:
+        return self._size
+
+    def batch(self, indices: np.ndarray) -> dict[str, np.ndarray]:
+        starts = indices.astype(np.int64) * self.seq_len
+        offsets = np.arange(self.seq_len + 1, dtype=np.int64)
+        window = starts[:, None] + offsets[None, :]
+        return {"tokens": np.asarray(self._data[window], dtype=np.int32)}
+
+
+def build_dataset(name: str, **kwargs) -> Dataset:
+    """Dataset registry keyed by config ``train.dataset``."""
+    builders = {
+        "synthetic": SyntheticRegressionDataset,
+        "synthetic_normal": lambda **kw: SyntheticRegressionDataset(
+            kind="normal", **kw),
+        "synthetic_linear": lambda **kw: SyntheticRegressionDataset(
+            kind="linear", **kw),
+        "synthetic_lm": SyntheticLMDataset,
+        "synthetic_images": SyntheticImageDataset,
+        "memmap_tokens": MemmapTokenDataset,
+    }
+    if name not in builders:
+        raise ValueError(
+            f"unknown dataset '{name}'; known: {sorted(builders)}")
+    return builders[name](**kwargs)

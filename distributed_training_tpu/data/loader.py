@@ -1,0 +1,149 @@
+"""Batch assembly: host rows → globally-sharded ``jax.Array`` batches.
+
+Replaces the reference's ``prepare_dataloader`` (``DataLoader`` +
+``DistributedSampler``, src/distributed_trainer.py:204-211). The torch
+stack hands each process a *local* tensor; the TPU-native shape is a
+single *global* ``jax.Array`` whose batch dimension is laid out over the
+mesh's data axes — each process materializes only the rows its devices
+own (``jax.make_array_from_callback``), so multi-host input never funnels
+through one host (SURVEY.md §7 "multi-host input pipeline").
+
+Shard → batch-row mapping: shard ``s`` (``dp``-major over ``(dp, fsdp)``,
+matching how ``PartitionSpec(("dp", "fsdp"))`` partitions the batch dim)
+contributes rows ``[s*b, (s+1)*b)`` of the global batch of size
+``b * num_shards``.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Iterator, Mapping
+
+import jax
+import numpy as np
+
+from distributed_training_tpu.data.sampler import DistributedShardSampler
+from distributed_training_tpu.runtime import Runtime
+
+
+class ShardedDataLoader:
+    """Epoch-based loader yielding dicts of globally-sharded jax.Arrays.
+
+    ``batch_size`` is per data shard, matching the reference semantics
+    where ``train.batch_size`` is per-rank (conf/train/default.yaml:1,
+    README "Input batch size on each device"); the global batch is
+    ``batch_size * runtime.data_shard_count``.
+    """
+
+    def __init__(self, dataset, runtime: Runtime, batch_size: int,
+                 shuffle: bool = True, seed: int = 0,
+                 drop_last: bool = False, max_steps_per_epoch: int = 0,
+                 prefetch_depth: int = 2):
+        if batch_size <= 0:
+            raise ValueError(f"batch_size must be > 0, got {batch_size}")
+        self.dataset = dataset
+        self.runtime = runtime
+        self.batch_size = batch_size
+        self.num_shards = runtime.data_shard_count
+        self.global_batch = batch_size * self.num_shards
+        self.sampler = DistributedShardSampler(
+            len(dataset), self.num_shards, shuffle=shuffle, seed=seed,
+            drop_last=drop_last)
+        # Final partial batch is wrap-padded to keep shapes static under
+        # jit (a partial batch would trigger recompilation). The torch
+        # DataLoader instead emits a short final batch; divergence is
+        # documented in docs/parity.md.
+        self.steps_per_epoch = -(-self.sampler.num_samples // batch_size)
+        if max_steps_per_epoch:
+            self.steps_per_epoch = min(self.steps_per_epoch,
+                                       max_steps_per_epoch)
+        self.prefetch_depth = prefetch_depth
+
+    def _epoch_shard_orders(self, epoch: int) -> np.ndarray:
+        """(num_shards, num_samples) index matrix for this epoch, with
+        per-shard wrap padding up to a batch multiple."""
+        self.sampler.set_epoch(epoch)
+        per_shard = np.stack([self.sampler.shard_indices(s)
+                              for s in range(self.num_shards)])
+        need = self.steps_per_epoch * self.batch_size
+        if per_shard.shape[1] < need:
+            reps = -(-need // per_shard.shape[1])
+            per_shard = np.concatenate([per_shard] * (reps + 1),
+                                       axis=1)[:, :need]
+        return per_shard
+
+    def _assemble(self, rows_by_shard: np.ndarray) -> dict[str, jax.Array]:
+        """Build the global sharded batch from per-shard row indices."""
+        sharding = self.runtime.batch_sharding
+        b = self.batch_size
+        # Probe one row to learn column names/shapes/dtypes without
+        # materializing anything remote.
+        probe = self.dataset.batch(rows_by_shard[:1, 0])
+        out: dict[str, jax.Array] = {}
+        for name, col in probe.items():
+            global_shape = (self.global_batch,) + col.shape[1:]
+
+            def cb(index, *, _name=name):
+                rows = index[0]
+                start = 0 if rows.start is None else rows.start
+                stop = global_shape[0] if rows.stop is None else rows.stop
+                idx = np.concatenate([
+                    rows_by_shard[s, :b]
+                    for s in range(start // b, -(-stop // b))
+                ])[start - (start // b) * b:][:stop - start]
+                return self.dataset.batch(idx)[_name]
+
+            out[name] = jax.make_array_from_callback(
+                global_shape, sharding, cb)
+        return out
+
+    def epoch(self, epoch: int) -> Iterator[Mapping[str, jax.Array]]:
+        """Iterate one epoch's batches (device-sharded), with background
+        host-side prefetch replacing DataLoader worker processes."""
+        orders = self._epoch_shard_orders(epoch)
+
+        def produce():
+            for step in range(self.steps_per_epoch):
+                sl = slice(step * self.batch_size,
+                           (step + 1) * self.batch_size)
+                yield self._assemble(orders[:, sl])
+
+        if self.prefetch_depth > 0:
+            yield from _prefetch(produce(), self.prefetch_depth)
+        else:
+            yield from produce()
+
+    def __len__(self) -> int:
+        return self.steps_per_epoch
+
+
+def _prefetch(it: Iterator, depth: int) -> Iterator:
+    """Run ``it`` in a daemon thread, keeping ``depth`` items ready.
+
+    The host-side analogue of DataLoader's worker+pin_memory pipelining
+    (reference: src/distributed_trainer.py:206-208): batch assembly and
+    H2D transfer overlap with device compute.
+    """
+    q: queue.Queue = queue.Queue(maxsize=depth)
+    _END = object()
+    err: list[BaseException] = []
+
+    def worker():
+        try:
+            for item in it:
+                q.put(item)
+        except BaseException as e:  # propagate into consumer
+            err.append(e)
+        finally:
+            q.put(_END)
+
+    t = threading.Thread(target=worker, daemon=True)
+    t.start()
+    while True:
+        item = q.get()
+        if item is _END:
+            if err:
+                raise err[0]
+            return
+        yield item

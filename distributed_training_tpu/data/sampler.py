@@ -1,0 +1,84 @@
+"""Deterministic distributed sampling with torch-DistributedSampler
+semantics.
+
+The reference shards its dataset with
+``DistributedSampler(dataset)`` + ``sampler.set_epoch(epoch)``
+(src/distributed_trainer.py:204-211,175; src/playground/ddp_script.py:
+124-132). Its contract, reproduced here exactly (SURVEY.md §7 "hard
+parts" — DistributedSampler fidelity):
+
+- ``num_samples = ceil(N / num_shards)``; ``total = num_samples * num_shards``
+- shuffle: permutation of ``range(N)`` seeded by ``seed + epoch``
+  (identical on every process — no cross-host communication needed)
+- padding: indices wrap around (``indices += indices[:total - N]``)
+- shard ``s`` takes ``indices[s::num_shards]`` (strided, as torch does)
+
+The RNG is NumPy's PCG64 rather than torch's MT19937, so *which*
+permutation a given seed yields differs from torch — the semantics
+(identical across processes, reshuffled per epoch) are what parity
+requires. ``drop_last=True`` matches torch's variant (drops the tail so
+every shard has ``floor(N / num_shards)`` samples).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class DistributedShardSampler:
+    """Yields per-shard index arrays for one epoch."""
+
+    def __init__(self, dataset_size: int, num_shards: int,
+                 shuffle: bool = True, seed: int = 0,
+                 drop_last: bool = False):
+        if dataset_size <= 0:
+            raise ValueError(f"dataset_size must be > 0, got {dataset_size}")
+        if num_shards <= 0:
+            raise ValueError(f"num_shards must be > 0, got {num_shards}")
+        self.dataset_size = dataset_size
+        self.num_shards = num_shards
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_last = drop_last
+        self.epoch = 0
+        if drop_last:
+            self.num_samples = dataset_size // num_shards
+            if self.num_samples == 0:
+                raise ValueError(
+                    f"drop_last with {num_shards} shards leaves no samples "
+                    f"from dataset of {dataset_size}")
+        else:
+            self.num_samples = -(-dataset_size // num_shards)  # ceil
+        self.total_size = self.num_samples * self.num_shards
+
+    def set_epoch(self, epoch: int) -> None:
+        """Reshuffle for a new epoch (parity:
+        src/distributed_trainer.py:175)."""
+        self.epoch = epoch
+
+    def global_indices(self) -> np.ndarray:
+        """The epoch's full index order before sharding, padded/truncated
+        to ``total_size``."""
+        if self.shuffle:
+            rng = np.random.default_rng(self.seed + self.epoch)
+            indices = rng.permutation(self.dataset_size)
+        else:
+            indices = np.arange(self.dataset_size)
+        if self.drop_last:
+            return indices[:self.total_size]
+        pad = self.total_size - self.dataset_size
+        if pad > 0:
+            reps = -(-pad // self.dataset_size)
+            indices = np.concatenate(
+                [indices] + [indices] * reps)[:self.total_size]
+        return indices
+
+    def shard_indices(self, shard: int) -> np.ndarray:
+        """Index array for one shard (torch's ``indices[rank::world]``)."""
+        if not 0 <= shard < self.num_shards:
+            raise ValueError(f"shard {shard} out of range "
+                             f"[0, {self.num_shards})")
+        return self.global_indices()[shard::self.num_shards]
+
+    def __len__(self) -> int:
+        return self.num_samples

@@ -1,0 +1,21 @@
+"""Parallelism strategies, expressed the TPU way.
+
+The reference's ``src/dist_strategy`` package wraps the model object in
+torch DDP/FSDP classes (dist_strategy.py:8-26; ddp_strategy.py:10-32;
+fsdp_strategy.py:13-46). In JAX, "DDP vs FSDP" is not two model-wrapping
+codepaths but two *sharding layouts over one mesh* applied to the same
+jitted train step (SURVEY.md §7): params replicated → XLA emits a gradient
+all-reduce (DDP); params sharded on ``fsdp`` → XLA emits all-gather on use
+and reduce-scatter on gradients (ZeRO-3). The strategy object's semantic
+content — "how are params laid out, how is the batch laid out, how are
+checkpoints materialized" — survives as PartitionSpec producers.
+"""
+
+from distributed_training_tpu.parallel.strategy import (  # noqa: F401
+    DataParallel,
+    FullyShardedDataParallel,
+    ShardingStrategy,
+    TensorParallel,
+    get_strategy,
+    logical_to_spec,
+)

@@ -1,0 +1,110 @@
+"""Strategy layer tests: spec inference, logical-axis routing, end-to-end
+sharding placement on the 8-device CPU mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from distributed_training_tpu.parallel import (
+    DataParallel, FullyShardedDataParallel, TensorParallel, get_strategy,
+    logical_to_spec,
+)
+from distributed_training_tpu.runtime import fake_cpu_runtime
+
+
+def test_ddp_replicates_everything():
+    s = DataParallel()
+    assert s.param_spec((1024, 1024), None) == P()
+    assert s.batch_spec() == P(("dp", "fsdp"))
+
+
+def test_fsdp_shards_largest_divisible_dim():
+    s = FullyShardedDataParallel(fsdp_size=4)
+    assert s.param_spec((512, 128), None) == P("fsdp", None)
+    assert s.param_spec((128, 512), None) == P(None, "fsdp")
+    # not divisible -> replicated
+    assert s.param_spec((130, 6), None) == P()
+    # too small -> replicated (bias vectors etc.)
+    assert s.param_spec((128,), None) == P()
+    # ties pick the first dim
+    assert s.param_spec((256, 256), None) == P("fsdp", None)
+
+
+def test_fsdp_size_one_is_ddp():
+    s = FullyShardedDataParallel(fsdp_size=1)
+    assert s.param_spec((1 << 20, 8), None) == P()
+
+
+def test_logical_to_spec_routing_and_conflicts():
+    rules = {"vocab": "tp", "embed": "fsdp", "mlp": "tp"}
+    assert logical_to_spec(("vocab", "embed"), rules) == P("tp", "fsdp")
+    # same mesh axis twice -> second use dropped
+    assert logical_to_spec(("mlp", "vocab"), rules) == P("tp")
+    assert logical_to_spec((None, "embed"), rules) == P(None, "fsdp")
+    assert logical_to_spec(("unknown",), rules) == P()
+
+
+def test_tp_logical_routing():
+    s = TensorParallel(fsdp_size=2, tp_size=4)
+    # column-parallel mlp kernel (embed, mlp)
+    assert s.param_spec((256, 1024), ("embed", "mlp")) == P("fsdp", "tp")
+    # attention out proj (heads, head_dim, embed)
+    assert s.param_spec((8, 64, 256), ("heads", None, "embed")) == \
+        P("tp", None, "fsdp")
+
+
+def test_specs_for_tree_with_eval_shape():
+    s = FullyShardedDataParallel(fsdp_size=8)
+    tree = {"w": jax.ShapeDtypeStruct((1024, 64), jnp.float32),
+            "b": jax.ShapeDtypeStruct((64,), jnp.float32)}
+    specs = s.specs_for_tree(tree)
+    assert specs["w"] == P("fsdp", None)
+    assert specs["b"] == P()
+
+
+def test_shardings_place_params_on_mesh(cpu8):
+    rt = fake_cpu_runtime(8, fsdp=8)
+    s = get_strategy("fsdp", rt.spec)
+    w = jnp.ones((1024, 32))
+    sh = s.shardings_for_tree(rt.mesh, {"w": w})["w"]
+    assert isinstance(sh, NamedSharding)
+    placed = jax.device_put(w, sh)
+    # each device holds 1/8 of the rows
+    shard_shape = placed.sharding.shard_shape(placed.shape)
+    assert shard_shape == (128, 32)
+
+
+def test_fsdp_grad_matches_ddp_math(cpu8):
+    """The semantic parity test: FSDP layout and DDP layout compute the
+    same gradients for the same global batch (XLA inserts different
+    collectives, math is identical)."""
+    rt_ddp = fake_cpu_runtime(8)           # dp=8
+    rt_fsdp = fake_cpu_runtime(8, fsdp=8)  # fsdp=8
+
+    w = jnp.linspace(-1, 1, 256 * 8).reshape(256, 8)
+    x = jnp.linspace(0, 1, 32 * 256).reshape(32, 256)
+    y = jnp.ones((32, 8))
+
+    def loss(w, x, y):
+        return jnp.mean((x @ w - y) ** 2)
+
+    grads = {}
+    for tag, rt, strat in (("ddp", rt_ddp, get_strategy("ddp", rt_ddp.spec)),
+                           ("fsdp", rt_fsdp,
+                            get_strategy("fsdp", rt_fsdp.spec,
+                                         min_shard_elems=1))):
+        wp = jax.device_put(w, strat.shardings_for_tree(rt.mesh, w))
+        xp = jax.device_put(x, NamedSharding(rt.mesh, strat.batch_spec()))
+        yp = jax.device_put(y, NamedSharding(rt.mesh, strat.batch_spec()))
+        g = jax.jit(jax.grad(loss))(wp, xp, yp)
+        grads[tag] = np.asarray(g)
+    np.testing.assert_allclose(grads["ddp"], grads["fsdp"], rtol=1e-5)
+
+
+def test_registry():
+    assert get_strategy("ddp").name == "ddp"
+    assert get_strategy("hybrid").name == "fsdp"
+    with pytest.raises(ValueError):
+        get_strategy("zorp")
