@@ -1,0 +1,94 @@
+#!/usr/bin/env python
+"""Headline benchmark: GPT-2 125M causal-LM training MFU on one chip.
+
+Prints ONE JSON line:
+``{"metric": ..., "value": N, "unit": ..., "vs_baseline": N}``.
+
+``vs_baseline`` is value / 0.4 — the BASELINE.json north-star MFU target
+(the reference publishes no numbers of its own; SURVEY.md §6).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+SEQ_LEN = 1024
+BATCH = 4  # naive-attention memory bound; raise when flash kernel lands
+WARMUP_STEPS = 3
+TIMED_STEPS = 10
+
+
+def main() -> None:
+    import jax
+    import numpy as np
+
+    from distributed_training_tpu.config import Config
+    from distributed_training_tpu.data import (ShardedDataLoader,
+                                               SyntheticLMDataset)
+    from distributed_training_tpu.models import build_model
+    from distributed_training_tpu.runtime import initialize_runtime
+    from distributed_training_tpu.utils.metrics import peak_flops_per_chip
+
+    cfg = Config()
+    cfg.train.batch_size = BATCH
+    cfg.train.optimizer = "adamw"
+    cfg.train.learning_rate = 6e-4
+    cfg.train.dtype = "bfloat16"
+    cfg.train.log_every = 0
+    cfg.train.parallel_strategy = "ddp"
+
+    rt = initialize_runtime(cfg)
+    model = build_model("gpt2_125m", dtype="bfloat16")
+    ds = SyntheticLMDataset(size=max(64, BATCH * rt.data_shard_count),
+                            seq_len=SEQ_LEN, vocab_size=50257, seed=0)
+    loader = ShardedDataLoader(ds, rt, batch_size=BATCH, shuffle=False)
+
+    from distributed_training_tpu.train.trainer import Trainer
+    trainer = Trainer(cfg, rt, model, loader)
+
+    batches = list(loader.epoch(0))
+    batch = batches[0]
+
+    for _ in range(WARMUP_STEPS):
+        metrics = trainer.train_step(batch)
+    jax.block_until_ready(metrics["loss"])
+
+    t0 = time.perf_counter()
+    for _ in range(TIMED_STEPS):
+        metrics = trainer.train_step(batch)
+    jax.block_until_ready(metrics["loss"])
+    dt = time.perf_counter() - t0
+
+    steps_per_sec = TIMED_STEPS / dt
+    tokens_per_step = loader.global_batch * SEQ_LEN
+    tokens_per_sec = steps_per_sec * tokens_per_step
+    flops_per_token = model.flops_per_token(SEQ_LEN)
+    model_flops_per_sec_per_chip = (tokens_per_sec * flops_per_token
+                                    / rt.num_devices)
+    mfu = model_flops_per_sec_per_chip / peak_flops_per_chip(
+        rt.device_kind)
+
+    result = {
+        "metric": "gpt2_125m_train_mfu_single_chip",
+        "value": round(float(mfu), 4),
+        "unit": "mfu",
+        "vs_baseline": round(float(mfu) / 0.4, 4),
+        "detail": {
+            "tokens_per_sec_per_chip": round(
+                tokens_per_sec / rt.num_devices, 1),
+            "step_time_ms": round(1000 * dt / TIMED_STEPS, 2),
+            "device_kind": rt.device_kind,
+            "num_devices": rt.num_devices,
+            "loss_finite": bool(np.isfinite(float(metrics["loss"]))),
+        },
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

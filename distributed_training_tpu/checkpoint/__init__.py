@@ -1,0 +1,5 @@
+"""Checkpointing subsystem (Orbax-backed)."""
+
+from distributed_training_tpu.checkpoint.manager import (  # noqa: F401
+    Checkpointer,
+)

@@ -1,0 +1,94 @@
+"""Sharded async checkpointing via Orbax.
+
+Replaces the reference's ``ModelCheckpoint`` + per-strategy serialization
+(src/distributed_trainer.py:73-105; ddp_strategy.py:23-32;
+fsdp_strategy.py:28-46) with one path that is correct for every layout:
+
+- **sharded save**: each host writes exactly its shards (the scalable
+  successor of the FSDP FULL_STATE_DICT gather, which OOMs at 7B and
+  deadlocked in the reference because only rank 0 entered the collective
+  — SURVEY.md §8 B6). Every process calls ``save``; Orbax coordinates.
+- **async**: training continues while the previous checkpoint drains to
+  storage (preemption-friendly, the idiomatic TPU pattern).
+- **full state**: params + optimizer state + step + epoch metadata; the
+  reference saved params only, silently resetting momentum on resume
+  (§5.4).
+- **resume-if-exists**: ``restore_latest`` mirrors the reference's
+  load-on-startup contract (src/distributed_trainer.py:97-105) but
+  restores each shard directly to its device (topology-change-tolerant:
+  Orbax reshards when the mesh differs from the one that saved).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any
+
+import orbax.checkpoint as ocp
+
+logger = logging.getLogger(__name__)
+
+
+class Checkpointer:
+    """Thin lifecycle wrapper over ``ocp.CheckpointManager``."""
+
+    def __init__(self, directory: str, max_to_keep: int = 3,
+                 async_save: bool = True):
+        self.directory = directory
+        options = ocp.CheckpointManagerOptions(
+            max_to_keep=max_to_keep,
+            create=True,
+            enable_async_checkpointing=async_save,
+        )
+        self._mgr = ocp.CheckpointManager(directory, options=options)
+
+    # -- save --------------------------------------------------------------
+
+    def save(self, step: int, state: Any, meta: dict | None = None,
+             force: bool = False) -> bool:
+        """Collective sharded save. Call from EVERY process."""
+        saved = self._mgr.save(
+            step,
+            args=ocp.args.Composite(
+                state=ocp.args.StandardSave(state),
+                meta=ocp.args.JsonSave(meta or {}),
+            ),
+            force=force,
+        )
+        if saved:
+            logger.info("checkpoint saved at step %d -> %s", step,
+                        self.directory)
+        return bool(saved)
+
+    # -- restore -----------------------------------------------------------
+
+    def latest_step(self) -> int | None:
+        return self._mgr.latest_step()
+
+    def restore_latest(self, abstract_state: Any
+                       ) -> tuple[Any, dict] | None:
+        """Restore the newest checkpoint into the given sharded layout,
+        or None if no checkpoint exists (fresh start — parity:
+        src/distributed_trainer.py:100-101)."""
+        step = self._mgr.latest_step()
+        if step is None:
+            return None
+        restored = self._mgr.restore(
+            step,
+            args=ocp.args.Composite(
+                state=ocp.args.StandardRestore(abstract_state),
+                meta=ocp.args.JsonRestore(),
+            ),
+        )
+        logger.info("restored checkpoint step %d from %s", step,
+                    self.directory)
+        return restored["state"], dict(restored["meta"] or {})
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def wait(self) -> None:
+        """Block until async saves are durable (call before exit)."""
+        self._mgr.wait_until_finished()
+
+    def close(self) -> None:
+        self._mgr.close()

@@ -63,10 +63,12 @@ class TrainConfig:
     remat: bool = False           # gradient checkpointing for big models
     loss: str = "auto"            # "auto" | "mse" | "xent" | "prob_xent"
     dataset: str = "synthetic"    # data source name
+    dataset_kwargs: dict[str, Any] = field(default_factory=dict)
     shuffle: bool = True
     drop_last: bool = False
     max_steps_per_epoch: int = 0  # 0 → whole shard (test/bench aid)
     nan_guard: bool = False       # skip+log non-finite update steps
+    min_shard_elems: int = 4096   # FSDP: replicate arrays smaller than this
     divergence_check_every: int = 0  # steps; 0 disables replica-drift check
     profile_dir: str = ""         # non-empty → jax.profiler traces here
 

@@ -132,8 +132,15 @@ class MemmapTokenDataset:
         return {"tokens": np.asarray(self._data[window], dtype=np.int32)}
 
 
-def build_dataset(name: str, **kwargs) -> Dataset:
-    """Dataset registry keyed by config ``train.dataset``."""
+def build_dataset(name: str, _defaults: dict | None = None,
+                  **kwargs) -> Dataset:
+    """Dataset registry keyed by config ``train.dataset``.
+
+    ``_defaults`` are soft kwargs (size/seed from TrainConfig) applied
+    only when the builder accepts them and the user didn't override —
+    file-backed datasets like ``memmap_tokens`` take neither.
+    Explicit ``kwargs`` are passed through unfiltered so typos fail loudly.
+    """
     builders = {
         "synthetic": SyntheticRegressionDataset,
         "synthetic_normal": lambda **kw: SyntheticRegressionDataset(
@@ -147,4 +154,18 @@ def build_dataset(name: str, **kwargs) -> Dataset:
     if name not in builders:
         raise ValueError(
             f"unknown dataset '{name}'; known: {sorted(builders)}")
-    return builders[name](**kwargs)
+    builder = builders[name]
+    if _defaults:
+        import inspect
+        try:
+            sig = inspect.signature(builder)
+            accepted = {
+                k: v for k, v in _defaults.items()
+                if k in sig.parameters or any(
+                    p.kind is inspect.Parameter.VAR_KEYWORD
+                    for p in sig.parameters.values())
+            }
+        except (TypeError, ValueError):  # pragma: no cover
+            accepted = dict(_defaults)
+        kwargs = {**accepted, **kwargs}
+    return builder(**kwargs)

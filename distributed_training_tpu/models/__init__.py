@@ -1,0 +1,14 @@
+"""Model zoo: plain-pytree functional models with logical sharding axes.
+
+The reference's "model zoo" is a single ``torch.nn.Linear`` built inline
+(src/distributed_trainer.py:199; playground: ddp_script.py:16-23). The
+framework generalizes to the BASELINE.json families — MLP, ResNet-18,
+GPT-2-class transformers (125M → 7B) — as *functional* models: explicit
+``init(rng) -> params`` pytrees and pure ``apply``/``loss`` functions.
+No module framework in the hot path: params are transparent pytrees that
+strategies annotate with logical axes and jit shards — the idiomatic
+SPMD shape for XLA.
+"""
+
+from distributed_training_tpu.models.base import Model  # noqa: F401
+from distributed_training_tpu.models.registry import build_model  # noqa: F401

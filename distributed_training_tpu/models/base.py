@@ -1,0 +1,58 @@
+"""Model protocol + shared initializers."""
+
+from __future__ import annotations
+
+from typing import Any, Mapping, Protocol, runtime_checkable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@runtime_checkable
+class Model(Protocol):
+    """Functional model contract consumed by the Trainer.
+
+    - ``init(rng)`` builds the param pytree (host-side shapes; sharding is
+      applied by the trainer via the strategy's specs).
+    - ``loss(params, batch, rng, train)`` returns ``(scalar_loss, metrics)``
+      — models own their loss so the trainer stays model-agnostic (the
+      reference hard-codes F.cross_entropy in the trainer,
+      src/distributed_trainer.py:163; see SURVEY.md §8 B5 for why that
+      pairing is degenerate).
+    - ``logical_axes()`` mirrors the param pytree with per-dim logical
+      names (``"embed"``, ``"mlp"``, ``"heads"``, ``"vocab"``, ...) that
+      strategies map to mesh axes; ``None`` → shape heuristics.
+    - ``flops_per_sample(seq_len?)`` powers MFU accounting.
+    """
+
+    def init(self, rng: jax.Array) -> Any: ...
+
+    def loss(self, params: Any, batch: Mapping[str, jax.Array],
+             rng: jax.Array, train: bool = True
+             ) -> tuple[jax.Array, dict[str, jax.Array]]: ...
+
+    def logical_axes(self) -> Any: ...
+
+    def flops_per_sample(self) -> float: ...
+
+
+def uniform_fan_in(rng: jax.Array, shape: tuple[int, ...], fan_in: int,
+                   dtype=jnp.float32) -> jax.Array:
+    """torch.nn.Linear default init: U(-1/sqrt(fan_in), 1/sqrt(fan_in)).
+
+    Loss-curve parity with the reference requires matching this family
+    (SURVEY.md §7 hard parts), not the distribution draw itself (different
+    RNG streams) — curves are compared statistically, not bitwise.
+    """
+    bound = 1.0 / np.sqrt(max(fan_in, 1))
+    return jax.random.uniform(rng, shape, dtype, -bound, bound)
+
+
+def normal_init(rng: jax.Array, shape: tuple[int, ...], stddev: float,
+                dtype=jnp.float32) -> jax.Array:
+    return stddev * jax.random.normal(rng, shape, dtype)
+
+
+def count_params(params: Any) -> int:
+    return sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params))

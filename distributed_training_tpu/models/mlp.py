@@ -1,0 +1,94 @@
+"""MLP family — the reference parity model.
+
+Covers both reference models: ``Linear(20, 1)`` (src/distributed_trainer.py:
+199, conf/model/default.yaml) and the playground's ``SimpleModel`` =
+``Linear(10, 1)`` (src/playground/ddp_script.py:16-23), generalized to an
+optional ReLU-hidden stack. Losses:
+
+- ``mse``: playground parity (ddp_script.py:135,146) — the task that
+  actually learns;
+- ``prob_xent``: exact semantics of the reference default trainer's
+  ``F.cross_entropy(logits, float_targets)`` over ``output_size`` logits
+  — for ``output_size=1`` this is the degenerate gradient-free loss the
+  reference ships (SURVEY.md §8 B5), reproduced for parity testing;
+- ``xent``: integer-label cross entropy (the non-degenerate variant).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+
+from distributed_training_tpu.models.base import uniform_fan_in
+
+
+@dataclass
+class MLP:
+    input_size: int = 20
+    output_size: int = 1
+    hidden_sizes: list[int] = field(default_factory=list)
+    loss_name: str = "mse"
+    dtype: str = "float32"
+
+    @property
+    def _dims(self) -> list[tuple[int, int]]:
+        dims = [self.input_size] + list(self.hidden_sizes) + \
+            [self.output_size]
+        return list(zip(dims[:-1], dims[1:]))
+
+    def init(self, rng: jax.Array):
+        params = {}
+        for i, (fan_in, fan_out) in enumerate(self._dims):
+            rng, wk, bk = jax.random.split(rng, 3)
+            params[f"layer{i}"] = {
+                # torch Linear stores (out, in); we store (in, out) for
+                # row-major x @ W — same init family either way.
+                "w": uniform_fan_in(wk, (fan_in, fan_out), fan_in),
+                "b": uniform_fan_in(bk, (fan_out,), fan_in),
+            }
+        return params
+
+    def apply(self, params, x: jax.Array) -> jax.Array:
+        h = x.astype(jnp.dtype(self.dtype))
+        n = len(self._dims)
+        for i in range(n):
+            lyr = params[f"layer{i}"]
+            h = h @ lyr["w"].astype(h.dtype) + lyr["b"].astype(h.dtype)
+            if i < n - 1:
+                h = jax.nn.relu(h)
+        return h
+
+    def loss(self, params, batch, rng: jax.Array, train: bool = True):
+        del rng, train
+        pred = self.apply(params, batch["x"]).astype(jnp.float32)
+        y = batch["y"]
+        if self.loss_name == "mse":
+            loss = jnp.mean((pred - y) ** 2)
+        elif self.loss_name == "prob_xent":
+            # F.cross_entropy with probability-mode float targets:
+            # -sum_c target_c * log_softmax(pred)_c, batch-meaned. With one
+            # logit log_softmax ≡ 0 → loss ≡ 0 (reference B5, preserved).
+            loss = jnp.mean(
+                -jnp.sum(y * jax.nn.log_softmax(pred, axis=-1), axis=-1))
+        elif self.loss_name == "xent":
+            labels = y.astype(jnp.int32).reshape(-1)
+            loss = jnp.mean(
+                -jnp.take_along_axis(
+                    jax.nn.log_softmax(pred, axis=-1),
+                    labels[:, None], axis=-1))
+        else:
+            raise ValueError(f"unknown loss '{self.loss_name}'")
+        return loss, {"loss": loss}
+
+    def logical_axes(self):
+        axes = {}
+        for i, _ in enumerate(self._dims):
+            axes[f"layer{i}"] = {"w": ("embed", "mlp"), "b": ("mlp",)}
+        return axes
+
+    def flops_per_sample(self) -> float:
+        # fwd+bwd ≈ 3 × (2 × flops of fwd matmuls)
+        fwd = sum(2 * a * b for a, b in self._dims)
+        return 3.0 * fwd

@@ -1,0 +1,31 @@
+"""Model registry keyed by config ``model.name``."""
+
+from __future__ import annotations
+
+from typing import Any
+
+
+def build_model(name: str, loss: str = "auto", dtype: str = "float32",
+                **kwargs: Any):
+    """Construct a model family from config.
+
+    ``loss="auto"`` keeps each family's natural default (MLP → mse like
+    the playground; transformer → next-token xent). The reference's
+    degenerate trainer pairing is available as ``loss=prob_xent``
+    (SURVEY.md §8 B5).
+    """
+    name = name.lower()
+    if name == "mlp":
+        from distributed_training_tpu.models.mlp import MLP
+        loss_name = "mse" if loss == "auto" else loss
+        return MLP(loss_name=loss_name, dtype=dtype, **kwargs)
+    if name in ("transformer", "gpt2", "gpt2_125m", "gpt2_350m",
+                "transformer_1b", "transformer_7b", "moe_transformer"):
+        from distributed_training_tpu.models.transformer import (
+            build_transformer,
+        )
+        return build_transformer(name, loss=loss, dtype=dtype, **kwargs)
+    if name in ("resnet", "resnet18"):
+        from distributed_training_tpu.models.resnet import ResNet
+        return ResNet(dtype=dtype, **kwargs)
+    raise ValueError(f"unknown model '{name}'")

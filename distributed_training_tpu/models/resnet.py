@@ -1,0 +1,149 @@
+"""ResNet-18 for CIFAR-10 (BASELINE.json config 2, 8-way data parallel).
+
+Functional pytree implementation over ``lax.conv_general_dilated`` (NHWC,
+the TPU-native conv layout). Normalization is GroupNorm(32) rather than
+BatchNorm: BN's running statistics are mutable cross-batch state that
+fights the pure-pytree train step and syncs badly across data-parallel
+replicas; GN is the standard stateless substitute with equivalent
+CIFAR-scale accuracy. Documented as a deliberate divergence in
+docs/parity.md.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_training_tpu.models.base import normal_init
+
+
+def _conv(x: jax.Array, w: jax.Array, stride: int = 1) -> jax.Array:
+    return jax.lax.conv_general_dilated(
+        x, w.astype(x.dtype),
+        window_strides=(stride, stride),
+        padding="SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+
+
+def _group_norm(x: jax.Array, scale: jax.Array, bias: jax.Array,
+                groups: int = 32) -> jax.Array:
+    dt = x.dtype
+    B, H, W, C = x.shape
+    g = min(groups, C)
+    while C % g:
+        g -= 1
+    xf = x.astype(jnp.float32).reshape(B, H, W, g, C // g)
+    mu = jnp.mean(xf, axis=(1, 2, 4), keepdims=True)
+    var = jnp.var(xf, axis=(1, 2, 4), keepdims=True)
+    xf = (xf - mu) * jax.lax.rsqrt(var + 1e-5)
+    xf = xf.reshape(B, H, W, C)
+    return (xf * scale + bias).astype(dt)
+
+
+@dataclass
+class ResNet:
+    """ResNet-18 (2-2-2-2 basic blocks), CIFAR stem (3x3, no max-pool)."""
+
+    num_classes: int = 10
+    width: int = 64
+    stage_sizes: list[int] = field(default_factory=lambda: [2, 2, 2, 2])
+    dtype: str = "float32"
+    loss_name: str = "xent"
+
+    def _stages(self):
+        chans = [self.width * (2 ** i) for i in range(len(self.stage_sizes))]
+        return list(zip(self.stage_sizes, chans))
+
+    def init(self, rng: jax.Array):
+        pdt = jnp.float32
+        n_keys = 4 + sum(self.stage_sizes) * 6
+        ks = iter(jax.random.split(rng, n_keys))
+
+        def conv_w(k, kh, kw, cin, cout):
+            # He/Kaiming normal (torch conv default family)
+            std = float(np.sqrt(2.0 / (kh * kw * cin)))
+            return normal_init(k, (kh, kw, cin, cout), std, pdt)
+
+        params: dict = {
+            "stem": {"w": conv_w(next(ks), 3, 3, 3, self.width),
+                     "scale": jnp.ones((self.width,), pdt),
+                     "bias": jnp.zeros((self.width,), pdt)},
+        }
+        cin = self.width
+        for si, (blocks, cout) in enumerate(self._stages()):
+            stage = []
+            for bi in range(blocks):
+                stride = 2 if (si > 0 and bi == 0) else 1
+                blk = {
+                    "conv1": conv_w(next(ks), 3, 3, cin, cout),
+                    "gn1": {"scale": jnp.ones((cout,), pdt),
+                            "bias": jnp.zeros((cout,), pdt)},
+                    "conv2": conv_w(next(ks), 3, 3, cout, cout),
+                    "gn2": {"scale": jnp.ones((cout,), pdt),
+                            "bias": jnp.zeros((cout,), pdt)},
+                }
+                if stride != 1 or cin != cout:
+                    blk["proj"] = conv_w(next(ks), 1, 1, cin, cout)
+                stage.append(blk)
+                cin = cout
+            params[f"stage{si}"] = stage
+        params["head"] = {
+            "w": normal_init(next(ks), (cin, self.num_classes),
+                             float(np.sqrt(1.0 / cin)), pdt),
+            "b": jnp.zeros((self.num_classes,), pdt),
+        }
+        return params
+
+    def apply(self, params, x: jax.Array) -> jax.Array:
+        x = x.astype(jnp.dtype(self.dtype))
+        s = params["stem"]
+        x = jax.nn.relu(_group_norm(_conv(x, s["w"]), s["scale"],
+                                    s["bias"]))
+        for si, (blocks, _cout) in enumerate(self._stages()):
+            for bi in range(blocks):
+                blk = params[f"stage{si}"][bi]
+                stride = 2 if (si > 0 and bi == 0) else 1
+                h = jax.nn.relu(_group_norm(
+                    _conv(x, blk["conv1"], stride),
+                    blk["gn1"]["scale"], blk["gn1"]["bias"]))
+                h = _group_norm(_conv(h, blk["conv2"]),
+                                blk["gn2"]["scale"], blk["gn2"]["bias"])
+                shortcut = (_conv(x, blk["proj"], stride)
+                            if "proj" in blk else x)
+                x = jax.nn.relu(h + shortcut)
+        x = jnp.mean(x, axis=(1, 2))  # global average pool
+        logits = x @ params["head"]["w"].astype(x.dtype) \
+            + params["head"]["b"].astype(x.dtype)
+        return logits.astype(jnp.float32)
+
+    def loss(self, params, batch, rng: jax.Array, train: bool = True):
+        del rng, train
+        logits = self.apply(params, batch["x"])
+        labels = batch["y"].astype(jnp.int32)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        loss = -jnp.mean(
+            jnp.take_along_axis(logp, labels[:, None], axis=-1))
+        acc = jnp.mean((jnp.argmax(logits, -1) == labels)
+                       .astype(jnp.float32))
+        return loss, {"loss": loss, "accuracy": acc}
+
+    def logical_axes(self):
+        # Convs shard fine under the shape heuristic; annotate None.
+        return None
+
+    def flops_per_sample(self) -> float:
+        # 2 flops/MAC, backward ≈ 2x forward; CIFAR 32x32 input.
+        hw = 32 * 32
+        total = 2 * 3 * 3 * 3 * self.width * hw
+        cin = self.width
+        for si, (blocks, cout) in enumerate(self._stages()):
+            scale = 4 ** si  # spatial halving per stage
+            for bi in range(blocks):
+                total += 2 * 9 * cin * cout * hw // scale
+                total += 2 * 9 * cout * cout * hw // scale
+                cin = cout
+        return 3.0 * total

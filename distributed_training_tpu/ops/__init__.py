@@ -1,0 +1,11 @@
+"""ops: TPU compute kernels and their reference implementations.
+
+The hot ops live here: attention (naive XLA reference, Pallas flash
+kernel, ring-attention sequence-parallel variant). Everything is a pure
+function over arrays so models stay kernel-agnostic; dispatch is by
+``impl=`` argument resolved from config.
+"""
+
+from distributed_training_tpu.ops.attention import (  # noqa: F401
+    dot_product_attention,
+)

@@ -1,0 +1,78 @@
+"""Attention implementations.
+
+- ``naive``: straightforward XLA attention (einsum softmax einsum) — the
+  numerics reference every kernel is tested against. XLA already fuses
+  this competently on TPU; it is the correctness baseline, not a toy.
+- ``flash``: Pallas blockwise-softmax kernel (ops/flash_attention.py) —
+  O(S) memory, MXU-tiled; used for long sequences / big models.
+- ``ring``: sequence-parallel ring attention (parallel/ring_attention.py)
+  — KV blocks rotate around the ``sp`` mesh axis via collective permute.
+
+The reference repo has no attention at all (models are Linear;
+SURVEY.md §5.7) — this module exists for the BASELINE.json transformer
+targets where MFU ≥ 0.4 requires a real attention path.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from einops import rearrange
+
+
+def _naive_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                     causal: bool = True,
+                     segment_mask: jax.Array | None = None) -> jax.Array:
+    """Reference attention. Shapes: q (B, Sq, H, D); k/v (B, Sk, Hkv, D).
+
+    Supports grouped-query attention (Hkv divides H). Softmax in fp32
+    regardless of input dtype (bf16-safe), output in q.dtype.
+    """
+    B, Sq, H, D = q.shape
+    Hkv = k.shape[2]
+    if H % Hkv:
+        raise ValueError(f"n_heads {H} not divisible by n_kv_heads {Hkv}")
+    group = H // Hkv
+    qg = rearrange(q, "b s (hkv g) d -> b s hkv g d", g=group)
+    scale = D ** -0.5
+    logits = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k,
+                        preferred_element_type=jnp.float32) * scale
+    if causal:
+        Sk = k.shape[1]
+        # Offset alignment: query i attends keys <= i + (Sk - Sq)
+        # (supports the ring-attention case where Sq < Sk).
+        mask = (jnp.arange(Sk)[None, :]
+                <= (jnp.arange(Sq)[:, None] + (Sk - Sq)))
+        logits = jnp.where(mask[None, None, None], logits, -jnp.inf)
+    if segment_mask is not None:
+        logits = jnp.where(segment_mask, logits, -jnp.inf)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhgqk,bkhd->bqhgd", probs.astype(v.dtype), v,
+                     preferred_element_type=jnp.float32)
+    return rearrange(out, "b q hkv g d -> b q (hkv g) d").astype(q.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "impl"))
+def _jitted_naive(q, k, v, causal, impl):
+    del impl
+    return _naive_attention(q, k, v, causal)
+
+
+def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                          causal: bool = True,
+                          impl: str = "auto") -> jax.Array:
+    """Dispatching attention entrypoint. ``impl``:
+
+    - "auto": flash on TPU when shapes are tile-friendly, else naive
+    - "naive" | "flash" | "ring"
+    """
+    if impl in ("auto", "flash"):
+        from distributed_training_tpu.ops import flash_attention as fa
+        if fa.supported(q, k, v) or impl == "flash":
+            return fa.flash_attention(q, k, v, causal=causal)
+        impl = "naive"
+    if impl == "naive":
+        return _naive_attention(q, k, v, causal)
+    raise ValueError(f"unknown attention impl '{impl}'")

@@ -1,0 +1,20 @@
+"""Pallas TPU flash attention (blockwise-softmax, O(S) memory).
+
+Kernel lands in the flash-attention milestone; until then ``supported``
+returns False and dispatch in ops/attention.py falls back to the naive
+XLA implementation, which is numerically identical.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def supported(q: jax.Array, k: jax.Array, v: jax.Array) -> bool:
+    return False
+
+
+def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                    causal: bool = True) -> jax.Array:
+    raise NotImplementedError(
+        "Pallas flash attention kernel not yet built; use impl='naive'")

@@ -25,6 +25,7 @@ Two spec sources compose:
 from __future__ import annotations
 
 import dataclasses
+import logging
 import math
 from abc import ABC, abstractmethod
 from typing import Any
@@ -35,6 +36,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from distributed_training_tpu.runtime import (
     AXIS_DP, AXIS_FSDP, AXIS_SP, AXIS_TP, BATCH_AXES,
 )
+
+logger = logging.getLogger(__name__)
 
 Rules = dict[str, str | tuple[str, ...] | None]
 
@@ -61,6 +64,47 @@ def logical_to_spec(logical: tuple[str | None, ...], rules: Rules) -> P:
     while assigned and assigned[-1] is None:
         assigned.pop()
     return P(*assigned)
+
+
+def prune_spec(shape: tuple[int, ...], spec: P, axis_sizes: dict[str, int],
+               min_elems: int = 0) -> P:
+    """Drop sharding assignments a given array can't honor: dims not
+    divisible by the assigned mesh-axis size, and fsdp assignments on
+    arrays too small to be worth a collective. Keeps the layout valid for
+    any model/mesh combination (tiny parity MLPs on big meshes included)."""
+    if len(spec) > len(shape):
+        raise ValueError(
+            f"logical axis annotation {tuple(spec)} has more dims than "
+            f"the array of shape {shape} — fix the model's logical_axes")
+    padded = list(spec) + [None] * (len(shape) - len(spec))
+    small = math.prod(shape) < min_elems if shape else True
+    out: list = []
+    for d, a in enumerate(padded):
+        if a is None:
+            out.append(None)
+            continue
+        flat = (a,) if isinstance(a, str) else tuple(a)
+        if any(x not in axis_sizes for x in flat):
+            # Axis whose size we don't know (user-extended rules): keep
+            # the assignment so XLA validates it loudly rather than
+            # silently replicating.
+            out.append(a)
+            continue
+        prod = math.prod(axis_sizes[x] for x in flat)
+        if shape[d] % prod != 0:
+            if prod > 1:
+                logger.warning(
+                    "dropping sharding %s on dim %d of %s: %d not "
+                    "divisible by mesh axes product %d — param will be "
+                    "replicated on %s", a, d, shape, shape[d], prod, flat)
+            out.append(None)
+        elif small and all(x == AXIS_FSDP for x in flat):
+            out.append(None)
+        else:
+            out.append(a)
+    while out and out[-1] is None:
+        out.pop()
+    return P(*out)
 
 
 def _largest_divisible_dim(shape: tuple[int, ...], size: int,
@@ -168,8 +212,10 @@ class FullyShardedDataParallel(ShardingStrategy):
         self.name = "fsdp"
 
     def param_spec(self, shape, logical) -> P:
+        sizes = {AXIS_FSDP: self.fsdp_size}
         if logical is not None:
-            spec = logical_to_spec(logical, self.rules)
+            spec = prune_spec(shape, logical_to_spec(logical, self.rules),
+                              sizes, self.min_shard_elems)
             if spec != P():
                 return spec
         dim = _largest_divisible_dim(shape, self.fsdp_size,
@@ -208,8 +254,10 @@ class TensorParallel(ShardingStrategy):
         self.name = "tp"
 
     def param_spec(self, shape, logical) -> P:
+        sizes = {AXIS_FSDP: self.fsdp_size, AXIS_TP: self.tp_size}
         if logical is not None:
-            return logical_to_spec(logical, self.rules)
+            return prune_spec(shape, logical_to_spec(logical, self.rules),
+                              sizes, self.min_shard_elems)
         dim = _largest_divisible_dim(shape, self.fsdp_size,
                                      self.min_shard_elems)
         if dim is None:

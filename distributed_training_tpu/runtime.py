@@ -149,6 +149,11 @@ class Runtime:
     def local_device_count(self) -> int:
         return jax.local_device_count()
 
+    @property
+    def device_kind(self) -> str:
+        """e.g. "TPU v5 lite" — feeds MFU's peak-FLOPs lookup."""
+        return self.mesh.devices.flat[0].device_kind
+
     # -- shardings ---------------------------------------------------------
 
     def sharding(self, *spec) -> NamedSharding:

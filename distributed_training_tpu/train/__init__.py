@@ -1,0 +1,14 @@
+"""Training orchestration: jitted train step + epoch loop.
+
+Replaces the reference's ``Trainer``/``main`` (src/distributed_trainer.py:
+108-192,243-276). The structural difference is the TPU execution model:
+instead of an eager per-batch loop whose collectives hide in autograd
+hooks, the whole optimization step — forward, backward, gradient
+collectives, optimizer update — is one jitted SPMD program whose
+parallelism comes from the strategy's sharding layout.
+"""
+
+from distributed_training_tpu.train.trainer import Trainer  # noqa: F401
+from distributed_training_tpu.train.optimizer import (  # noqa: F401
+    build_optimizer,
+)

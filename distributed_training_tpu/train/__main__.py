@@ -1,0 +1,3 @@
+from distributed_training_tpu.train.cli import main
+
+raise SystemExit(main())

@@ -1,0 +1,91 @@
+"""Training entrypoint.
+
+Usage (parity with the reference's Hydra CLI,
+``python src/distributed_trainer.py train.batch_size=64 ...``,
+src/distributed_trainer.py:243-276):
+
+    python -m distributed_training_tpu.train [key=value ...]
+    python -m distributed_training_tpu.train --config-dir conf model=gpt2
+
+Also exposed under the reference's historical entrypoint name via
+``multigpu_multi_node.py`` at the repo root (the name the reference's
+cloud bootstrap launches — which didn't exist there; SURVEY.md §8 B1).
+One process per host on TPU pods; ``jax.distributed`` handles rendezvous.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+
+logger = logging.getLogger(__name__)
+
+
+def build_argparser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="dtt-train",
+        description="TPU-native distributed training")
+    p.add_argument("--config-dir", default=None,
+                   help="config root (default: <repo>/conf)")
+    p.add_argument("--config-name", default="config")
+    p.add_argument("overrides", nargs="*",
+                   help="key.path=value config overrides")
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_argparser().parse_args(argv)
+
+    from distributed_training_tpu.config import load_config, save_resolved
+    from distributed_training_tpu.runtime import initialize_runtime
+    from distributed_training_tpu.utils.logging import setup_logging
+
+    cfg = load_config(args.config_dir, args.config_name, args.overrides)
+
+    run_dir = os.path.join(cfg.run.output_dir, cfg.run.experiment_name)
+    os.makedirs(run_dir, exist_ok=True)
+
+    rt = initialize_runtime(cfg)
+    setup_logging(cfg.run.log_level,
+                  os.path.join(run_dir, cfg.run.log_file),
+                  rt.process_index)
+    logger.info("config loaded; %s", rt.describe())
+    if rt.is_coordinator:
+        save_resolved(cfg, os.path.join(run_dir, "resolved_config.yaml"))
+
+    from distributed_training_tpu.checkpoint import Checkpointer
+    from distributed_training_tpu.data import (ShardedDataLoader,
+                                               build_dataset)
+    from distributed_training_tpu.models import build_model
+    from distributed_training_tpu.train.trainer import Trainer
+
+    dataset = build_dataset(
+        cfg.train.dataset,
+        _defaults={"size": cfg.train.dataset_size,
+                   "seed": cfg.train.seed},
+        **cfg.train.dataset_kwargs,
+    )
+    loader = ShardedDataLoader(
+        dataset, rt,
+        batch_size=cfg.train.batch_size,
+        shuffle=cfg.train.shuffle,
+        seed=cfg.train.seed,
+        drop_last=cfg.train.drop_last,
+        max_steps_per_epoch=cfg.train.max_steps_per_epoch,
+    )
+    model = build_model(cfg.model.name, loss=cfg.train.loss,
+                        dtype=cfg.train.dtype, **cfg.model.kwargs)
+    checkpointer = Checkpointer(cfg.train.snapshot_path)
+
+    trainer = Trainer(cfg, rt, model, loader, checkpointer)
+    summary = trainer.train()
+    if rt.is_coordinator:
+        logger.info("training done: %s", summary)
+    checkpointer.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,49 @@
+"""Optimizer construction (optax).
+
+Parity baseline: plain SGD at ``cfg.learning_rate``
+(reference: src/distributed_trainer.py:200, conf/train/default.yaml:6),
+extended with the knobs the BASELINE.json transformer configs need
+(AdamW, warmup+cosine schedule, global-norm clipping). Unlike the
+reference — which builds the optimizer against pre-FSDP-wrap params
+(SURVEY.md §8 B4) — optimizer state here is born sharded: the trainer
+jits ``optimizer.init`` with the strategy's output shardings.
+"""
+
+from __future__ import annotations
+
+import optax
+
+from distributed_training_tpu.config import TrainConfig
+
+
+def build_schedule(cfg: TrainConfig, total_steps: int):
+    base = cfg.learning_rate
+    if cfg.lr_schedule == "constant":
+        sched = optax.constant_schedule(base)
+    elif cfg.lr_schedule == "cosine":
+        decay_steps = max(total_steps - cfg.warmup_steps, 1)
+        sched = optax.cosine_decay_schedule(
+            base, decay_steps=decay_steps, alpha=0.1)
+    else:
+        raise ValueError(f"unknown lr_schedule '{cfg.lr_schedule}'")
+    if cfg.warmup_steps > 0:
+        warmup = optax.linear_schedule(0.0, base, cfg.warmup_steps)
+        sched = optax.join_schedules([warmup, sched], [cfg.warmup_steps])
+    return sched
+
+
+def build_optimizer(cfg: TrainConfig,
+                    total_steps: int) -> optax.GradientTransformation:
+    sched = build_schedule(cfg, total_steps)
+    if cfg.optimizer == "sgd":
+        core = optax.sgd(sched)
+    elif cfg.optimizer == "adamw":
+        core = optax.adamw(sched, b1=cfg.b1, b2=cfg.b2,
+                           weight_decay=cfg.weight_decay)
+    else:
+        raise ValueError(f"unknown optimizer '{cfg.optimizer}'")
+    parts = []
+    if cfg.grad_clip_norm and cfg.grad_clip_norm > 0:
+        parts.append(optax.clip_by_global_norm(cfg.grad_clip_norm))
+    parts.append(core)
+    return optax.chain(*parts)

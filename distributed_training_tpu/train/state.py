@@ -1,0 +1,71 @@
+"""Train state: a transparent pytree, born sharded.
+
+``{"params", "opt_state", "step"}`` — the unit the checkpoint layer
+saves/restores (superset of the reference's ``{"MODEL_STATE",
+"EPOCHS_RUN"}`` snapshot, src/distributed_trainer.py:88-91, which dropped
+optimizer state entirely; SURVEY.md §5.4).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from distributed_training_tpu.parallel.strategy import ShardingStrategy
+
+
+def state_specs(strategy: ShardingStrategy,
+                optimizer: optax.GradientTransformation,
+                param_shapes: Any, logical_axes: Any = None) -> dict:
+    """PartitionSpecs for the full train state.
+
+    Optimizer-state leaves that mirror params (Adam moments, momentum)
+    inherit the param's spec via ``optax.tree_map_params``; scalar/other
+    leaves replicate.
+    """
+    param_specs = strategy.specs_for_tree(param_shapes, logical_axes)
+    opt_shapes = jax.eval_shape(optimizer.init, param_shapes)
+    opt_specs = optax.tree_map_params(
+        optimizer,
+        lambda _leaf, spec: spec,
+        opt_shapes,
+        param_specs,
+        transform_non_params=lambda _leaf: P(),
+        is_leaf=lambda x: isinstance(x, jax.ShapeDtypeStruct),
+    )
+    return {"params": param_specs, "opt_state": opt_specs, "step": P()}
+
+
+def state_shardings(mesh: Mesh, specs: dict) -> dict:
+    return jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
+                        is_leaf=lambda x: isinstance(x, P))
+
+
+def init_state(model, optimizer, rng: jax.Array, shardings: dict) -> dict:
+    """Initialize params and optimizer state directly into their sharded
+    layout — no host-side full materialization, so 7B-class models
+    never need to fit on one host (contrast: the reference builds the
+    full model on every rank then wraps, src/distributed_trainer.py:137)."""
+    params = jax.jit(model.init,
+                     out_shardings=shardings["params"])(rng)
+    opt_state = jax.jit(optimizer.init,
+                        out_shardings=shardings["opt_state"])(params)
+    step = jnp.zeros((), jnp.int32)
+    return {"params": params, "opt_state": opt_state, "step": step}
+
+
+def abstract_state(model, optimizer, rng: jax.Array,
+                   shardings: dict) -> dict:
+    """ShapeDtypeStructs (with shardings attached) for checkpoint
+    restore-in-place."""
+    p_shapes = jax.eval_shape(model.init, rng)
+    o_shapes = jax.eval_shape(optimizer.init, p_shapes)
+    shapes = {"params": p_shapes, "opt_state": o_shapes,
+              "step": jax.ShapeDtypeStruct((), jnp.int32)}
+    return jax.tree.map(
+        lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
+        shapes, shardings)

@@ -1,0 +1,209 @@
+"""The Trainer: one jitted SPMD train step + epoch orchestration.
+
+Replaces the reference ``Trainer`` (src/distributed_trainer.py:108-192):
+same externally-visible behavior — epoch loop resuming from the last
+checkpointed epoch, per-``save_every`` checkpointing, per-epoch logging —
+with the compute path redesigned for XLA: forward+backward+update is a
+single compiled program with donated inputs; DDP's gradient all-reduce and
+FSDP's all-gather/reduce-scatter are emitted by the compiler from the
+strategy's sharding layout (no imperative collectives anywhere).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any, Iterable, Mapping
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from distributed_training_tpu.config import Config
+from distributed_training_tpu.models.base import count_params
+from distributed_training_tpu.parallel.strategy import ShardingStrategy
+from distributed_training_tpu.runtime import Runtime
+from distributed_training_tpu.train import state as state_lib
+from distributed_training_tpu.train.optimizer import build_optimizer
+from distributed_training_tpu.utils.metrics import MetricsLogger
+
+logger = logging.getLogger(__name__)
+
+
+def make_train_step(model, optimizer: optax.GradientTransformation,
+                    nan_guard: bool = False):
+    """Build the pure train-step function (pre-jit).
+
+    The entire reference ``_run_batch`` (zero_grad → forward → loss →
+    backward → step, src/distributed_trainer.py:160-165) plus the
+    collective layer beneath it, as one traced function.
+    """
+
+    def train_step(state: dict, batch: Mapping[str, jax.Array],
+                   base_rng: jax.Array):
+        params, opt_state, step = (state["params"], state["opt_state"],
+                                   state["step"])
+        rng = jax.random.fold_in(base_rng, step)
+
+        def loss_fn(p):
+            loss, metrics = model.loss(p, batch, rng, train=True)
+            return loss, metrics
+
+        (loss, metrics), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params)
+        updates, new_opt = optimizer.update(grads, opt_state, params)
+        new_params = optax.apply_updates(params, updates)
+
+        gnorm = optax.global_norm(grads)
+        metrics = dict(metrics)
+        metrics["grad_norm"] = gnorm
+
+        if nan_guard:
+            # Skip non-finite update steps instead of poisoning params —
+            # replaces "watch the logs for NaN" (SURVEY.md §5.2).
+            ok = jnp.isfinite(loss) & jnp.isfinite(gnorm)
+            new_params = jax.tree.map(
+                lambda new, old: jnp.where(ok, new, old),
+                new_params, params)
+            new_opt = jax.tree.map(
+                lambda new, old: jnp.where(ok, new, old),
+                new_opt, opt_state)
+            metrics["skipped_nonfinite"] = (~ok).astype(jnp.float32)
+
+        new_state = {"params": new_params, "opt_state": new_opt,
+                     "step": step + 1}
+        return new_state, metrics
+
+    return train_step
+
+
+class Trainer:
+    """Config-driven training orchestrator."""
+
+    def __init__(self, cfg: Config, runtime: Runtime, model,
+                 loader, checkpointer=None):
+        self.cfg = cfg
+        self.rt = runtime
+        self.model = model
+        self.loader = loader
+        self.checkpointer = checkpointer
+        tcfg = cfg.train
+
+        from distributed_training_tpu.parallel import get_strategy
+        self.strategy: ShardingStrategy = get_strategy(
+            tcfg.parallel_strategy, runtime.spec,
+            min_shard_elems=tcfg.min_shard_elems)
+
+        total_steps = tcfg.total_steps or (
+            loader.steps_per_epoch * tcfg.total_epochs)
+        self.optimizer = build_optimizer(tcfg, total_steps)
+
+        rng = jax.random.PRNGKey(tcfg.seed)
+        self.init_rng, self.step_rng = jax.random.split(rng)
+
+        param_shapes = jax.eval_shape(model.init, self.init_rng)
+        logical = (model.logical_axes()
+                   if hasattr(model, "logical_axes") else None)
+        self.state_shardings = state_lib.state_shardings(
+            runtime.mesh,
+            state_lib.state_specs(self.strategy, self.optimizer,
+                                  param_shapes, logical))
+        self.batch_sharding = NamedSharding(runtime.mesh,
+                                            self.strategy.batch_spec())
+
+        self._step_fn = jax.jit(
+            make_train_step(model, self.optimizer,
+                            nan_guard=tcfg.nan_guard),
+            donate_argnums=(0,),
+            out_shardings=(self.state_shardings,
+                           NamedSharding(runtime.mesh, P())),
+        )
+
+        # Resume-if-exists (parity: ModelCheckpoint.load on startup,
+        # src/distributed_trainer.py:157,97-105) — but restoring optimizer
+        # state and step too, which the reference dropped (§5.4).
+        self.epochs_run = 0
+        restored = None
+        if checkpointer is not None:
+            abstract = state_lib.abstract_state(
+                model, self.optimizer, self.init_rng, self.state_shardings)
+            restored = checkpointer.restore_latest(abstract)
+        if restored is not None:
+            self.state, meta = restored
+            self.epochs_run = int(meta.get("epoch", -1)) + 1
+            logger.info("resumed from checkpoint: epoch=%d step=%d",
+                        self.epochs_run, int(self.state["step"]))
+        else:
+            self.state = state_lib.init_state(
+                model, self.optimizer, self.init_rng, self.state_shardings)
+            logger.info("initialized fresh state: %d params",
+                        count_params(self.state["params"]))
+        # Host-side mirror of state["step"]: reading the device scalar
+        # every step would force a host-device sync per step and defeat
+        # async dispatch + prefetch.
+        self.global_step = int(self.state["step"])
+
+        self.metrics = MetricsLogger(
+            log_every=tcfg.log_every,
+            samples_per_step=loader.global_batch,
+            flops_per_sample=(model.flops_per_sample()
+                              if hasattr(model, "flops_per_sample") else 0),
+            num_devices=runtime.num_devices,
+            enabled=runtime.is_coordinator,
+            device_kind=runtime.device_kind,
+        )
+
+    # -- loops -------------------------------------------------------------
+
+    def train_step(self, batch) -> Mapping[str, jax.Array]:
+        self.state, metrics = self._step_fn(self.state, batch,
+                                            self.step_rng)
+        self.global_step += 1
+        return metrics
+
+    def _run_epoch(self, epoch: int) -> dict[str, float]:
+        """Parity: Trainer._run_epoch (src/distributed_trainer.py:167-183)
+        — sampler reshuffle per epoch, batch loop — without the
+        wasted peek-batch (§8 B3)."""
+        losses = []
+        for batch in self.loader.epoch(epoch):
+            metrics = self.train_step(batch)
+            self.metrics.record(self.global_step, metrics, epoch=epoch)
+            losses.append(metrics["loss"])
+        # One host sync per epoch, not per step.
+        mean_loss = float(np.mean([float(l) for l in losses]))
+        return {"epoch": epoch, "mean_loss": mean_loss}
+
+    def train(self, max_epochs: int | None = None) -> dict[str, float]:
+        """Parity: Trainer.train (src/distributed_trainer.py:185-192)."""
+        max_epochs = max_epochs or self.cfg.train.total_epochs
+        summary: dict[str, float] = {}
+        t0 = time.perf_counter()
+        for epoch in range(self.epochs_run, max_epochs):
+            summary = self._run_epoch(epoch)
+            if self.rt.is_coordinator:
+                logger.info("epoch %d | mean_loss %.6f", epoch,
+                            summary["mean_loss"])
+            if (self.checkpointer is not None
+                    and epoch % self.cfg.train.save_every == 0):
+                # Collective save: every process participates (fixes the
+                # reference's rank-0-only FSDP save hang, SURVEY.md §8 B6).
+                self.checkpointer.save(
+                    self.global_step, self.state, meta={"epoch": epoch})
+            self.epochs_run = epoch + 1
+        if self.checkpointer is not None:
+            self.checkpointer.wait()
+        summary["wall_time_s"] = time.perf_counter() - t0
+        return summary
+
+    # -- eval --------------------------------------------------------------
+
+    def evaluate(self, batches: Iterable[Mapping[str, Any]]) -> float:
+        """Mean loss over batches without updating state."""
+        eval_fn = jax.jit(
+            lambda p, b, r: self.model.loss(p, b, r, train=False)[0])
+        losses = [float(eval_fn(self.state["params"], b, self.step_rng))
+                  for b in batches]
+        return float(np.mean(losses)) if losses else float("nan")

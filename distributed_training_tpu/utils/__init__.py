@@ -1,0 +1,1 @@
+"""Utilities: logging, metrics/MFU, profiling, divergence guards."""

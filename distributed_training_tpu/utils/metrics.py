@@ -1,0 +1,84 @@
+"""Metrics: step timing, throughput, MFU accounting.
+
+The reference logs only epoch boundaries and batch counts
+(src/distributed_trainer.py:169-173); its README's performance guides are
+an unfulfilled roadmap item (README.md:198). The BASELINE.json metric —
+samples/sec/chip + MFU — requires real instrumentation, so this module is
+a first-class subsystem (SURVEY.md §5.1/§5.5).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from dataclasses import dataclass, field
+
+logger = logging.getLogger(__name__)
+
+# Peak dense bf16 FLOPs per chip. Sources: public TPU spec sheets.
+TPU_PEAK_FLOPS: dict[str, float] = {
+    "v4": 275e12,
+    "v5e": 197e12,
+    "v5 lite": 197e12,
+    "v5p": 459e12,
+    "v6e": 918e12,
+    "cpu": 1e11,  # nominal, keeps MFU finite in tests
+}
+
+
+def peak_flops_per_chip(device_kind: str) -> float:
+    kind = device_kind.lower()
+    for key, flops in TPU_PEAK_FLOPS.items():
+        if key in kind:
+            return flops
+    return TPU_PEAK_FLOPS["cpu"]
+
+
+def compute_mfu(model_flops_per_sec_per_chip: float,
+                device_kind: str) -> float:
+    return model_flops_per_sec_per_chip / peak_flops_per_chip(device_kind)
+
+
+@dataclass
+class MetricsLogger:
+    """Rolling per-step throughput/loss logging on the coordinator."""
+
+    log_every: int = 10
+    samples_per_step: int = 0
+    flops_per_sample: float = 0.0
+    num_devices: int = 1
+    enabled: bool = True
+    device_kind: str = "cpu"
+
+    _last_time: float = field(default_factory=time.perf_counter)
+    _last_step: int = 0
+    history: list[dict] = field(default_factory=list)
+
+    def record(self, step: int, metrics: dict, epoch: int = 0) -> None:
+        if not self.enabled or self.log_every <= 0:
+            return
+        if step % self.log_every != 0:
+            return
+        now = time.perf_counter()
+        dsteps = max(step - self._last_step, 1)
+        dt = max(now - self._last_time, 1e-9)
+        steps_per_sec = dsteps / dt
+        samples_per_sec = steps_per_sec * self.samples_per_step
+        entry = {
+            "epoch": epoch,
+            "step": step,
+            "loss": float(metrics.get("loss", float("nan"))),
+            "steps_per_sec": steps_per_sec,
+            "samples_per_sec_per_chip": samples_per_sec / self.num_devices,
+        }
+        if self.flops_per_sample:
+            flops_per_chip = (samples_per_sec * self.flops_per_sample
+                              / self.num_devices)
+            entry["mfu"] = compute_mfu(flops_per_chip, self.device_kind)
+        self.history.append(entry)
+        logger.info(
+            "step %d | epoch %d | loss %.6f | %.1f samples/s/chip%s",
+            step, epoch, entry["loss"], entry["samples_per_sec_per_chip"],
+            f" | mfu {entry['mfu']:.3f}" if "mfu" in entry else "")
+        self._last_time = now
+        self._last_step = step

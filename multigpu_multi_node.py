@@ -1,0 +1,14 @@
+#!/usr/bin/env python
+"""Compatibility entrypoint under the reference's historical launch name.
+
+The reference's cloud bootstrap and README launch
+``src/multigpu_multi_node.py`` — a file that never existed there
+(cloud-init.tftpl:67,77, README.md:59; SURVEY.md §8 B1). This framework
+provides the name for drop-in launcher compatibility; it is exactly
+``python -m distributed_training_tpu.train``.
+"""
+
+from distributed_training_tpu.train.cli import main
+
+if __name__ == "__main__":
+    raise SystemExit(main())
