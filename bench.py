@@ -18,7 +18,7 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 SEQ_LEN = 1024
-BATCH = 4  # naive-attention memory bound; raise when flash kernel lands
+BATCH = 8
 WARMUP_STEPS = 3
 TIMED_STEPS = 10
 

@@ -167,12 +167,16 @@ def _set_path(tree: dict[str, Any], dotted: str, value: Any,
 
 
 def compose(config_dir: str, config_name: str = "config",
-            overrides: list[str] | None = None) -> dict[str, Any]:
-    """Compose the raw config dict: root YAML + defaults groups + overrides.
+            overrides: list[str] | None = None,
+            base_tree: dict[str, Any] | None = None) -> dict[str, Any]:
+    """Compose the raw config dict: base defaults + root YAML + defaults
+    groups + overrides.
 
     Mirrors the reference's Hydra composition of conf/config.yaml's
     ``defaults: [model: default, train: default]`` (conf/config.yaml:1-4)
-    without the chdir side effects.
+    without the chdir side effects. ``base_tree`` (the typed schema's
+    defaults) is merged underneath so every schema field is a valid
+    override target even when the YAML files don't spell it out.
     """
     overrides = list(overrides or [])
     root = _load_yaml(os.path.join(config_dir, f"{config_name}.yaml"))
@@ -202,7 +206,7 @@ def compose(config_dir: str, config_name: str = "config",
         selections.append((group, group_over.pop(group, name)))
     selections.extend(group_over.items())
 
-    tree: dict[str, Any] = {}
+    tree: dict[str, Any] = copy.deepcopy(base_tree) if base_tree else {}
     for group, name in selections:
         group_file = os.path.join(config_dir, group, f"{name}.yaml")
         tree = _deep_merge(tree, {group: _load_yaml(group_file)})
@@ -266,7 +270,11 @@ def load_config(config_dir: str | None = None, config_name: str = "config",
         config_dir = os.path.join(
             os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
             "conf")
-    tree = compose(config_dir, config_name, overrides)
+    base = Config().to_dict()
+    # ModelConfig's open kwargs dict is presentation-only; model YAMLs
+    # write hyperparameters at the top level of the model group.
+    base["model"].pop("kwargs", None)
+    tree = compose(config_dir, config_name, overrides, base_tree=base)
     cfg = config_from_dict(tree)
     # Anchor snapshot_path against output_dir at load time (not at save
     # time, and with no per-run chdir) so restarts launched the same way

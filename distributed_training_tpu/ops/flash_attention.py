@@ -1,20 +1,405 @@
-"""Pallas TPU flash attention (blockwise-softmax, O(S) memory).
+"""Pallas TPU flash attention: blockwise online-softmax, O(S) memory.
 
-Kernel lands in the flash-attention milestone; until then ``supported``
-returns False and dispatch in ops/attention.py falls back to the naive
-XLA implementation, which is numerically identical.
+Forward + custom-VJP backward, both as Pallas kernels. Design (per the
+TPU kernel playbook, /opt/skills/guides/pallas_guide.md):
+
+- grid ``(B, H, nq, nk)``: the innermost ``nk`` dimension executes
+  sequentially per core, so softmax statistics (running max ``m``,
+  normalizer ``l``) and the output accumulator live in VMEM scratch and
+  carry across k-blocks; the q-block output is finalized on the last
+  k-step. Q/K/V blocks stream HBM→VMEM via BlockSpec pipelining (the
+  compiler double-buffers automatically).
+- all matmuls hit the MXU with fp32 accumulation
+  (``preferred_element_type``); inputs may be bf16.
+- causal masking is applied per-block; fully-masked k-blocks are skipped
+  with ``pl.when`` so the causal program does ~half the FLOPs.
+- backward uses the saved logsumexp and ``delta = rowsum(dO * O)``
+  (computed in XLA, it fuses) and two kernels: dq (accumulate over
+  k-blocks) and dkv (accumulate over q-blocks) — the standard
+  FlashAttention-2 decomposition.
+
+Layout contract: wrapper takes (B, S, H, D) like ops.attention, kernels
+work in (B, H, S, D). GQA is handled by repeating KV heads in the
+wrapper. Sequence lengths must divide the block size (the transformer's
+seq lens are powers of two ≥ 128; others fall back to naive).
 """
 
 from __future__ import annotations
 
+import functools
+
 import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+DEFAULT_BLOCK_Q = 256
+DEFAULT_BLOCK_K = 256
+NEG_INF = -1e30
+
+
+def _block_needed(causal: bool, q_start, k_start, block_q: int):
+    """False only for k-blocks entirely above the causal diagonal."""
+    return jnp.logical_or(not causal, k_start <= q_start + block_q - 1)
+
+
+def _apply_causal_mask(s, q_start, k_start, block_q: int, block_k: int):
+    rows = q_start + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    cols = k_start + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    return jnp.where(cols <= rows, s, NEG_INF)
+
+
+def _platform_is_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except RuntimeError:  # pragma: no cover
+        return False
 
 
 def supported(q: jax.Array, k: jax.Array, v: jax.Array) -> bool:
-    return False
+    """Should auto-dispatch route here? (Else: naive fallback.)
+
+    Conservative by design: off-TPU the interpreter would be orders of
+    magnitude slower than XLA's fused naive path, and the kernel's
+    causal mask assumes Sq == Sk (no bottom-right offset).
+    """
+    del v
+    if not _platform_is_tpu():
+        return False
+    if q.dtype not in (jnp.float32, jnp.bfloat16):
+        return False
+    if q.shape[1] != k.shape[1]:
+        return False
+    if q.shape[1] < 128:
+        return False
+    bq = min(DEFAULT_BLOCK_Q, q.shape[1])
+    bk = min(DEFAULT_BLOCK_K, k.shape[1])
+    if q.shape[1] % bq or k.shape[1] % bk:
+        return False
+    if q.shape[3] > 256:
+        return False
+    if q.shape[2] % k.shape[2]:
+        return False
+    return True
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                acc_ref, m_ref, l_ref, *, scale, block_q, block_k,
+                causal):
+    qi = pl.program_id(2)
+    ki = pl.program_id(3)
+    nk = pl.num_programs(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    q_start = qi * block_q
+    k_start = ki * block_k
+    # Causal: skip blocks entirely above the diagonal.
+    needed = _block_needed(causal, q_start, k_start, block_q)
+
+    @pl.when(needed)
+    def _compute():
+        q = q_ref[0, 0]  # (block_q, d)
+        k = k_ref[0, 0]  # (block_k, d)
+        v = v_ref[0, 0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # (bq, bk)
+        if causal:
+            s = _apply_causal_mask(s, q_start, k_start, block_q, block_k)
+
+        m_prev = m_ref[:]                          # (bq, 1)
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)                     # (bq, bk) f32
+        alpha = jnp.exp(m_prev - m_new)            # (bq, 1)
+        l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[:] = m_new
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        l = l_ref[:]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
+        lse_ref[0, 0] = m_ref[:] + jnp.log(l_safe)  # (bq, 1)
+
+
+def _flash_fwd(q, k, v, *, causal, block_q, block_k):
+    B, H, S, D = q.shape
+    Sk = k.shape[2]
+    scale = D ** -0.5
+    nq, nk = S // block_q, Sk // block_k
+    grid = (B, H, nq, nk)
+
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, block_q=block_q, block_k=block_k,
+        causal=causal)
+
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, D),
+                         lambda b, h, qi, ki: (b, h, qi, 0)),
+            pl.BlockSpec((1, 1, block_k, D),
+                         lambda b, h, qi, ki: (b, h, ki, 0)),
+            pl.BlockSpec((1, 1, block_k, D),
+                         lambda b, h, qi, ki: (b, h, ki, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, D),
+                         lambda b, h, qi, ki: (b, h, qi, 0)),
+            # trailing dim of 1: satisfies the (8, 128)-or-full tiling
+            # rule for the per-row logsumexp residual
+            pl.BlockSpec((1, 1, block_q, 1),
+                         lambda b, h, qi, ki: (b, h, qi, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, S, D), q.dtype),
+            jax.ShapeDtypeStruct((B, H, S, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, D), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+        ],
+        interpret=not _platform_is_tpu(),
+    )(q, k, v)
+    return out, lse
+
+
+# ---------------------------------------------------------------------------
+# backward
+# ---------------------------------------------------------------------------
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   dq_ref, dq_acc, *, scale, block_q, block_k, causal):
+    qi = pl.program_id(2)
+    ki = pl.program_id(3)
+    nk = pl.num_programs(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    q_start = qi * block_q
+    k_start = ki * block_k
+    needed = _block_needed(causal, q_start, k_start, block_q)
+
+    @pl.when(needed)
+    def _compute():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0]                       # (bq, 1)
+        delta = delta_ref[0, 0]                   # (bq, 1)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            s = _apply_causal_mask(s, q_start, k_start, block_q, block_k)
+        p = jnp.exp(s - lse)                       # (bq, bk)
+        dp = jax.lax.dot_general(
+            do, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        dq_acc[:] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        dq_ref[0, 0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_acc, dv_acc, *, scale, block_q,
+                    block_k, causal):
+    ki = pl.program_id(2)
+    qi = pl.program_id(3)
+    nq = pl.num_programs(3)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    q_start = qi * block_q
+    k_start = ki * block_k
+    needed = _block_needed(causal, q_start, k_start, block_q)
+
+    @pl.when(needed)
+    def _compute():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0]                       # (bq, 1)
+        delta = delta_ref[0, 0]                   # (bq, 1)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            s = _apply_causal_mask(s, q_start, k_start, block_q, block_k)
+        p = jnp.exp(s - lse)                       # (bq, bk)
+        dv_acc[:] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)    # (bk, d)
+        dp = jax.lax.dot_general(
+            do, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale              # (bq, bk)
+        dk_acc[:] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)    # (bk, d)
+
+    @pl.when(qi == nq - 1)
+    def _finalize():
+        dk_ref[0, 0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _flash_bwd(q, k, v, out, lse, do, *, causal, block_q, block_k):
+    B, H, S, D = q.shape
+    Sk = k.shape[2]
+    scale = D ** -0.5
+    nq, nk = S // block_q, Sk // block_k
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1, keepdims=True)  # (B, H, S, 1) — fuses in XLA
+
+    interp = not _platform_is_tpu()
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, block_q=block_q,
+                          block_k=block_k, causal=causal),
+        grid=(B, H, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, D),
+                         lambda b, h, qi, ki: (b, h, qi, 0)),
+            pl.BlockSpec((1, 1, block_k, D),
+                         lambda b, h, qi, ki: (b, h, ki, 0)),
+            pl.BlockSpec((1, 1, block_k, D),
+                         lambda b, h, qi, ki: (b, h, ki, 0)),
+            pl.BlockSpec((1, 1, block_q, D),
+                         lambda b, h, qi, ki: (b, h, qi, 0)),
+            pl.BlockSpec((1, 1, block_q, 1),
+                         lambda b, h, qi, ki: (b, h, qi, 0)),
+            pl.BlockSpec((1, 1, block_q, 1),
+                         lambda b, h, qi, ki: (b, h, qi, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block_q, D),
+                               lambda b, h, qi, ki: (b, h, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, H, S, D), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
+        interpret=interp,
+    )(q, k, v, do, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, block_q=block_q,
+                          block_k=block_k, causal=causal),
+        grid=(B, H, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, D),
+                         lambda b, h, ki, qi: (b, h, qi, 0)),
+            pl.BlockSpec((1, 1, block_k, D),
+                         lambda b, h, ki, qi: (b, h, ki, 0)),
+            pl.BlockSpec((1, 1, block_k, D),
+                         lambda b, h, ki, qi: (b, h, ki, 0)),
+            pl.BlockSpec((1, 1, block_q, D),
+                         lambda b, h, ki, qi: (b, h, qi, 0)),
+            pl.BlockSpec((1, 1, block_q, 1),
+                         lambda b, h, ki, qi: (b, h, qi, 0)),
+            pl.BlockSpec((1, 1, block_q, 1),
+                         lambda b, h, ki, qi: (b, h, qi, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_k, D),
+                         lambda b, h, ki, qi: (b, h, ki, 0)),
+            pl.BlockSpec((1, 1, block_k, D),
+                         lambda b, h, ki, qi: (b, h, ki, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, Sk, D), k.dtype),
+            jax.ShapeDtypeStruct((B, H, Sk, D), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, D), jnp.float32),
+            pltpu.VMEM((block_k, D), jnp.float32),
+        ],
+        interpret=interp,
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# public API (custom VJP over BHSD internals, BSHD at the boundary)
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash_bhsd(q, k, v, causal, block_q, block_k):
+    out, _ = _flash_fwd(q, k, v, causal=causal, block_q=block_q,
+                        block_k=block_k)
+    return out
+
+
+def _flash_bhsd_fwd(q, k, v, causal, block_q, block_k):
+    out, lse = _flash_fwd(q, k, v, causal=causal, block_q=block_q,
+                          block_k=block_k)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bhsd_bwd(causal, block_q, block_k, res, do):
+    q, k, v, out, lse = res
+    dq, dk, dv = _flash_bwd(q, k, v, out, lse, do, causal=causal,
+                            block_q=block_q, block_k=block_k)
+    return dq, dk, dv
+
+
+_flash_bhsd.defvjp(_flash_bhsd_fwd, _flash_bhsd_bwd)
 
 
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
-                    causal: bool = True) -> jax.Array:
-    raise NotImplementedError(
-        "Pallas flash attention kernel not yet built; use impl='naive'")
+                    causal: bool = True,
+                    block_q: int = DEFAULT_BLOCK_Q,
+                    block_k: int = DEFAULT_BLOCK_K) -> jax.Array:
+    """Flash attention over (B, S, H, D) inputs (GQA allowed)."""
+    B, S, H, D = q.shape
+    Hkv = k.shape[2]
+    if S != k.shape[1] and causal:
+        raise ValueError(
+            f"flash kernel's causal mask requires Sq == Sk, got "
+            f"{S} vs {k.shape[1]}; use impl='naive'")
+    if H % Hkv:
+        raise ValueError(
+            f"n_heads {H} not divisible by n_kv_heads {Hkv}")
+    if H != Hkv:
+        reps = H // Hkv
+        k = jnp.repeat(k, reps, axis=2)
+        v = jnp.repeat(v, reps, axis=2)
+    bq = min(block_q, S)
+    bk = min(block_k, k.shape[1])
+    if S % bq or k.shape[1] % bk:
+        raise ValueError(
+            f"sequence lengths ({S}, {k.shape[1]}) must be divisible by "
+            f"block sizes ({bq}, {bk}); pad or use impl='naive'")
+    qt = jnp.transpose(q, (0, 2, 1, 3))
+    kt = jnp.transpose(k, (0, 2, 1, 3))
+    vt = jnp.transpose(v, (0, 2, 1, 3))
+    out = _flash_bhsd(qt, kt, vt, causal, bq, bk)
+    return jnp.transpose(out, (0, 2, 1, 3))
