@@ -214,8 +214,19 @@ def compose(config_dir: str, config_name: str = "config",
     tree = _deep_merge(tree, root)
 
     for key, val, allow_new in leaf_over:
-        _set_path(tree, key, yaml.safe_load(val), allow_new)
+        _set_path(tree, key, yaml.safe_load(val),
+                  allow_new or _is_open_path(key))
     return tree
+
+
+def _is_open_path(dotted: str) -> bool:
+    """Open-schema override targets need no ``+``: the ``model`` group
+    (hyperparameters are family-specific, carried via ModelConfig.kwargs)
+    and any ``*_kwargs`` mapping (e.g. train.dataset_kwargs)."""
+    parts = dotted.split(".")
+    if parts[0] == "model" and len(parts) > 1:
+        return True
+    return any(p.endswith("_kwargs") for p in parts[:-1])
 
 
 # ---------------------------------------------------------------------------

@@ -29,6 +29,7 @@ from dataclasses import dataclass
 
 import jax
 import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
 
 from distributed_training_tpu.models.base import normal_init
 from distributed_training_tpu.ops.attention import dot_product_attention
@@ -124,6 +125,33 @@ class Transformer:
 
     def __init__(self, cfg: TransformerConfig):
         self.cfg = cfg
+        self.mesh = None  # bound by the trainer for ring attention
+
+    def bind_mesh(self, mesh) -> None:
+        """Give the model the device mesh (needed only when
+        ``attention_impl='ring'``: the shard_map over the ``sp`` axis is
+        constructed against a concrete mesh)."""
+        self.mesh = mesh
+
+    def _attention(self, q, k, v):
+        c = self.cfg
+        if c.attention_impl == "ring":
+            from distributed_training_tpu.parallel.ring_attention import (
+                make_ring_attention,
+            )
+            from distributed_training_tpu.runtime import AXIS_TP
+            if self.mesh is None:
+                raise ValueError(
+                    "attention_impl='ring' requires bind_mesh(mesh) "
+                    "before tracing (the Trainer does this)")
+            sizes = dict(zip(self.mesh.axis_names,
+                             self.mesh.devices.shape))
+            head_ax = AXIS_TP if sizes.get(AXIS_TP, 1) > 1 else None
+            fn = make_ring_attention(self.mesh, causal=True,
+                                     head_axis=head_ax)
+            return fn(q, k, v)
+        return dot_product_attention(q, k, v, causal=True,
+                                     impl=c.attention_impl)
 
     # -- init --------------------------------------------------------------
 
@@ -229,8 +257,7 @@ class Transformer:
         v = jnp.einsum("bsd,dhk->bshk", h, layer["attn"]["wv"].astype(dt))
         if c.pos_encoding == "rope":
             q, k = _rope(q, k, positions)
-        attn = dot_product_attention(q, k, v, causal=True,
-                                     impl=c.attention_impl)
+        attn = self._attention(q, k, v)
         x = x + jnp.einsum("bshk,hkd->bsd", attn,
                            layer["attn"]["wo"].astype(dt))
 

@@ -19,8 +19,10 @@ TPU kernel playbook, /opt/skills/guides/pallas_guide.md):
   FlashAttention-2 decomposition.
 
 Layout contract: wrapper takes (B, S, H, D) like ops.attention, kernels
-work in (B, H, S, D). GQA is handled by repeating KV heads in the
-wrapper. Sequence lengths must divide the block size (the transformer's
+work in (B, H, S, D). GQA keeps K/V at Hkv heads end-to-end: the KV
+BlockSpec index maps route q-head ``h`` to kv-head ``h // reps``, so
+grouped heads are never materialized (dk/dv are group-reduced after the
+kernel). Sequence lengths must divide the block size (the transformer's
 seq lens are powers of two ≥ 128; others fall back to naive).
 """
 
@@ -139,8 +141,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
 
 
 def _flash_fwd(q, k, v, *, causal, block_q, block_k):
+    """q: (B, H, S, D); k/v: (B, Hkv, Sk, D) with Hkv dividing H — GQA is
+    expressed in the KV BlockSpec index maps (h → h // reps), so grouped
+    KV heads are never materialized at H resolution in HBM."""
     B, H, S, D = q.shape
     Sk = k.shape[2]
+    reps = H // k.shape[1]
     scale = D ** -0.5
     nq, nk = S // block_q, Sk // block_k
     grid = (B, H, nq, nk)
@@ -156,9 +162,9 @@ def _flash_fwd(q, k, v, *, causal, block_q, block_k):
             pl.BlockSpec((1, 1, block_q, D),
                          lambda b, h, qi, ki: (b, h, qi, 0)),
             pl.BlockSpec((1, 1, block_k, D),
-                         lambda b, h, qi, ki: (b, h, ki, 0)),
+                         lambda b, h, qi, ki: (b, h // reps, ki, 0)),
             pl.BlockSpec((1, 1, block_k, D),
-                         lambda b, h, qi, ki: (b, h, ki, 0)),
+                         lambda b, h, qi, ki: (b, h // reps, ki, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, 1, block_q, D),
@@ -278,6 +284,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 def _flash_bwd(q, k, v, out, lse, do, *, causal, block_q, block_k):
     B, H, S, D = q.shape
     Sk = k.shape[2]
+    reps = H // k.shape[1]
     scale = D ** -0.5
     nq, nk = S // block_q, Sk // block_k
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
@@ -292,9 +299,9 @@ def _flash_bwd(q, k, v, out, lse, do, *, causal, block_q, block_k):
             pl.BlockSpec((1, 1, block_q, D),
                          lambda b, h, qi, ki: (b, h, qi, 0)),
             pl.BlockSpec((1, 1, block_k, D),
-                         lambda b, h, qi, ki: (b, h, ki, 0)),
+                         lambda b, h, qi, ki: (b, h // reps, ki, 0)),
             pl.BlockSpec((1, 1, block_k, D),
-                         lambda b, h, qi, ki: (b, h, ki, 0)),
+                         lambda b, h, qi, ki: (b, h // reps, ki, 0)),
             pl.BlockSpec((1, 1, block_q, D),
                          lambda b, h, qi, ki: (b, h, qi, 0)),
             pl.BlockSpec((1, 1, block_q, 1),
@@ -309,6 +316,8 @@ def _flash_bwd(q, k, v, out, lse, do, *, causal, block_q, block_k):
         interpret=interp,
     )(q, k, v, do, lse, delta)
 
+    # dk/dv are computed per q-head (grid over H) and group-reduced to
+    # Hkv afterwards; KV reads stay at Hkv resolution via the index map.
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, block_q=block_q,
                           block_k=block_k, causal=causal),
@@ -317,9 +326,9 @@ def _flash_bwd(q, k, v, out, lse, do, *, causal, block_q, block_k):
             pl.BlockSpec((1, 1, block_q, D),
                          lambda b, h, ki, qi: (b, h, qi, 0)),
             pl.BlockSpec((1, 1, block_k, D),
-                         lambda b, h, ki, qi: (b, h, ki, 0)),
+                         lambda b, h, ki, qi: (b, h // reps, ki, 0)),
             pl.BlockSpec((1, 1, block_k, D),
-                         lambda b, h, ki, qi: (b, h, ki, 0)),
+                         lambda b, h, ki, qi: (b, h // reps, ki, 0)),
             pl.BlockSpec((1, 1, block_q, D),
                          lambda b, h, ki, qi: (b, h, qi, 0)),
             pl.BlockSpec((1, 1, block_q, 1),
@@ -343,6 +352,9 @@ def _flash_bwd(q, k, v, out, lse, do, *, causal, block_q, block_k):
         ],
         interpret=interp,
     )(q, k, v, do, lse, delta)
+    if reps > 1:
+        dk = dk.reshape(B, H // reps, reps, Sk, D).sum(axis=2)
+        dv = dv.reshape(B, H // reps, reps, Sk, D).sum(axis=2)
     return dq, dk, dv
 
 
@@ -388,10 +400,6 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     if H % Hkv:
         raise ValueError(
             f"n_heads {H} not divisible by n_kv_heads {Hkv}")
-    if H != Hkv:
-        reps = H // Hkv
-        k = jnp.repeat(k, reps, axis=2)
-        v = jnp.repeat(v, reps, axis=2)
     bq = min(block_q, S)
     bk = min(block_k, k.shape[1])
     if S % bq or k.shape[1] % bk:

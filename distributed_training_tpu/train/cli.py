@@ -75,8 +75,11 @@ def main(argv: list[str] | None = None) -> int:
         drop_last=cfg.train.drop_last,
         max_steps_per_epoch=cfg.train.max_steps_per_epoch,
     )
+    model_kwargs = dict(cfg.model.kwargs)
+    # model-level dtype override wins over the training compute dtype
+    model_dtype = model_kwargs.pop("dtype", cfg.train.dtype)
     model = build_model(cfg.model.name, loss=cfg.train.loss,
-                        dtype=cfg.train.dtype, **cfg.model.kwargs)
+                        dtype=model_dtype, **model_kwargs)
     checkpointer = Checkpointer(cfg.train.snapshot_path)
 
     trainer = Trainer(cfg, rt, model, loader, checkpointer)

@@ -95,6 +95,8 @@ class Trainer:
         self.strategy: ShardingStrategy = get_strategy(
             tcfg.parallel_strategy, runtime.spec,
             min_shard_elems=tcfg.min_shard_elems)
+        if hasattr(model, "bind_mesh"):
+            model.bind_mesh(runtime.mesh)
 
         total_steps = tcfg.total_steps or (
             loader.steps_per_epoch * tcfg.total_epochs)
