@@ -29,7 +29,6 @@ from dataclasses import dataclass
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import PartitionSpec as P
 
 from distributed_training_tpu.models.base import normal_init
 from distributed_training_tpu.ops.attention import dot_product_attention
@@ -51,6 +50,7 @@ class TransformerConfig:
     param_dtype: str = "float32"
     remat: bool = False
     attention_impl: str = "auto"
+    pp_microbatches: int = 4      # GPipe microbatches when mesh pp > 1
     # MoE (expert-parallel): > 0 turns every MLP into a top-k routed
     # expert layer with a load-balancing aux loss.
     moe_num_experts: int = 0
@@ -289,16 +289,49 @@ class Transformer:
         # leading L dim.
         stacked = {k: params[k] for k in ("ln1", "ln2", "attn", "mlp")}
 
+        pp = 1
+        if self.mesh is not None:
+            pp = dict(zip(self.mesh.axis_names,
+                          self.mesh.devices.shape)).get("pp", 1)
+
         def body(carry, layer):
             x, aux = carry
             x, layer_aux = self._block(x, layer, positions)
             return (x, aux + layer_aux), None
 
-        block = body
-        if c.remat:
-            block = jax.checkpoint(body, prevent_cse=False)
-        (x, aux), _ = jax.lax.scan(
-            block, (x, jnp.zeros((), jnp.float32)), stacked)
+        if pp > 1:
+            # GPipe wavefront over pp stages (parallel/pipeline.py):
+            # each stage scans its local layer shard per microbatch.
+            if c.attention_impl == "ring":
+                raise ValueError(
+                    "pipeline (pp>1) + ring attention not composable "
+                    "yet; use attention_impl='naive'/'flash'")
+            from distributed_training_tpu.parallel.pipeline import (
+                pipeline_apply,
+            )
+            from distributed_training_tpu.runtime import BATCH_AXES
+
+            def stage_body(stage_params, xb):
+                (xb, aux), _ = jax.lax.scan(
+                    body, (xb, jnp.zeros((), jnp.float32)), stage_params)
+                return xb, aux
+
+            # largest microbatch count <= pp_microbatches dividing B
+            M = max(m for m in range(1, min(c.pp_microbatches, B) + 1)
+                    if B % m == 0)
+            x, aux = pipeline_apply(
+                stage_body, stacked, x, self.mesh,
+                num_microbatches=M, batch_axes=BATCH_AXES)
+            # aux is an intensive (batch-mean) statistic summed over M
+            # microbatches — renormalize so pp meshes optimize the same
+            # objective as non-pp meshes.
+            aux = aux / M
+        else:
+            block = body
+            if c.remat:
+                block = jax.checkpoint(body, prevent_cse=False)
+            (x, aux), _ = jax.lax.scan(
+                block, (x, jnp.zeros((), jnp.float32)), stacked)
         aux = aux / c.n_layers  # mean load-balancing loss over layers
 
         x = _layer_norm(x, params["final_norm"]["scale"],

@@ -1,0 +1,138 @@
+"""Pipeline parallelism (GPipe-style) over the ``pp`` mesh axis.
+
+The transformer's decoder stack is already a *stacked-layer* pytree
+(leaves shaped ``(L, ...)``, models/transformer.py), which makes pipeline
+parallelism a sharding statement plus a schedule:
+
+- **layout**: shard the stacked-layer leading dim over ``pp`` — stage
+  ``i`` physically holds layers ``[i*L/pp, (i+1)*L/pp)``. This is the
+  partition jit cannot exploit on its own (layers execute sequentially),
+  hence the explicit schedule.
+- **schedule**: split the batch into ``M`` microbatches and run the
+  classic GPipe wavefront for ``M + pp - 1`` ticks inside ``shard_map``:
+  stage 0 injects microbatch ``t``; every stage applies its local layers
+  to its buffer; buffers rotate to the next stage via ``ppermute``
+  (XLA collective-permute on ICI); the last stage banks finished
+  microbatches. Bubble fraction is ``(pp-1)/(M+pp-1)`` — pick M ≫ pp.
+- **backward**: plain autodiff. ``ppermute`` transposes to the reverse
+  permute, so the same schedule runs backwards (activations rematerialize
+  per-stage via the remat'd tick).
+
+All devices execute the same program every tick (SPMD — no
+data-dependent communication); stage roles differ only by masking on
+``axis_index``. The reference repo has no pipeline (SURVEY.md §2.3);
+this exists so deep models scale past one chip's HBM along depth as
+well as width.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+from distributed_training_tpu.runtime import AXIS_PP
+
+
+def pipeline_spec(leaf_ndim: int) -> P:
+    """Spec for a stacked-layer param leaf inside the pipeline
+    shard_map: leading (layer) dim over pp, rest replicated."""
+    return P(AXIS_PP, *([None] * (leaf_ndim - 1)))
+
+
+def _pipelined(stage_params, x_mb, aux0, *, body_fn, num_microbatches,
+               axis_name):
+    """Runs inside shard_map. stage_params leaves: (L/pp, ...) local
+    shard; x_mb: (M, B_mb, S, D) microbatched activations (replicated
+    across pp); returns processed (M, B_mb, S, D) + summed aux."""
+    pp = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    M = num_microbatches
+    T = M + pp - 1
+    perm = [(i, (i + 1) % pp) for i in range(pp)]
+
+    buf = jnp.zeros_like(x_mb[0])
+    out = jnp.zeros_like(x_mb)
+    aux_acc = aux0
+
+    def tick(carry, t):
+        buf, out, aux_acc = carry
+        # stage 0 injects microbatch t while t < M
+        inject = x_mb[jnp.clip(t, 0, M - 1)]
+        is_stage0 = (idx == 0)
+        take = jnp.logical_and(is_stage0, t < M)
+        buf = jnp.where(take, inject, buf)
+
+        buf, aux = body_fn(stage_params, buf)
+        # only count aux for ticks where this stage held real data:
+        # stage i is busy for t in [i, i + M)
+        busy = jnp.logical_and(t >= idx, t < idx + M)
+        aux_acc = aux_acc + jnp.where(busy, aux, 0.0)
+
+        # last stage banks microbatch t - (pp - 1)
+        done_t = t - (pp - 1)
+        is_last = (idx == pp - 1)
+        bank = jnp.logical_and(is_last,
+                               jnp.logical_and(done_t >= 0, done_t < M))
+        out = jax.lax.dynamic_update_index_in_dim(
+            out,
+            jnp.where(bank, buf, out[jnp.clip(done_t, 0, M - 1)]),
+            jnp.clip(done_t, 0, M - 1), axis=0)
+
+        buf = jax.lax.ppermute(buf, axis_name, perm)
+        return (buf, out, aux_acc), None
+
+    (buf, out, aux_acc), _ = jax.lax.scan(
+        jax.checkpoint(tick, prevent_cse=False), (buf, out, aux_acc),
+        jnp.arange(T))
+    del buf
+
+    # results live on the last stage; broadcast to all stages so the
+    # (replicated-over-pp) head/loss sees them: mask + psum.
+    keep = (idx == pp - 1).astype(out.dtype)
+    out = jax.lax.psum(out * keep, axis_name)
+    # aux was accumulated per-stage over its own layers: sum of stages.
+    aux_acc = jax.lax.psum(aux_acc, axis_name)
+    return out, aux_acc
+
+
+def pipeline_apply(body_fn: Callable, stacked_params, x: jax.Array,
+                   mesh: Mesh, num_microbatches: int,
+                   batch_axes=(), axis_name: str = AXIS_PP):
+    """Apply ``body_fn`` (one stage's layers over one microbatch:
+    ``(stage_params, x) -> (x, aux)``) as a GPipe pipeline.
+
+    ``x``: (B, S, D) activations; B must divide into ``num_microbatches``.
+    ``stacked_params``: pytree with leading layer dim on every leaf.
+    Returns ``(x_out, aux_sum)`` with x_out shaped like x.
+    """
+    B = x.shape[0]
+    M = num_microbatches
+    if B % M:
+        raise ValueError(f"batch {B} not divisible by microbatches {M}")
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    pp = sizes.get(axis_name, 1)
+    L = jax.tree.leaves(stacked_params)[0].shape[0]
+    if L % pp:
+        raise ValueError(f"{L} layers not divisible by {pp} stages")
+
+    x_mb = x.reshape(M, B // M, *x.shape[1:])
+
+    param_specs = jax.tree.map(
+        lambda leaf: pipeline_spec(leaf.ndim), stacked_params)
+    xspec = P(None, tuple(batch_axes) or None, None, None)
+
+    fn = shard_map(
+        functools.partial(_pipelined, body_fn=body_fn,
+                          num_microbatches=M, axis_name=axis_name),
+        mesh=mesh,
+        in_specs=(param_specs, xspec, P()),
+        out_specs=(xspec, P()),
+        check_rep=False,
+    )
+    out_mb, aux = fn(stacked_params, x_mb, jnp.zeros((), jnp.float32))
+    return out_mb.reshape(B, *x.shape[1:]), aux

@@ -1,0 +1,12 @@
+"""Playground: distributed training re-derived from collective primitives.
+
+The pedagogical layer — parity with the reference's
+``src/playground/ddp_script.py`` ("DDP from ground up", README.md:24-26):
+where the production trainer lets XLA *infer* collectives from sharding
+layouts, the playground calls them *explicitly* so you can see exactly
+what data parallelism is made of.
+"""
+
+from distributed_training_tpu.playground.ddp_from_primitives import (  # noqa: F401,E501
+    train_ddp,
+)

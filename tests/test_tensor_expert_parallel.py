@@ -1,0 +1,92 @@
+"""Tensor-parallel and expert-parallel end-to-end coverage: the same
+model/batch/seed must produce the same loss trajectory under every
+layout (DDP reference vs TP vs EP-sharded MoE)."""
+
+import jax
+import numpy as np
+import pytest
+
+from distributed_training_tpu.config import Config
+from distributed_training_tpu.data import (ShardedDataLoader,
+                                           SyntheticLMDataset)
+from distributed_training_tpu.models.transformer import (
+    Transformer, TransformerConfig)
+from distributed_training_tpu.parallel import get_strategy
+from distributed_training_tpu.runtime import fake_cpu_runtime
+from distributed_training_tpu.train.trainer import Trainer
+
+
+def run_losses(rt, strategy, model_kwargs=None, steps=3):
+    cfg = Config()
+    cfg.train.batch_size = 2
+    cfg.train.total_epochs = 1
+    cfg.train.log_every = 0
+    cfg.train.learning_rate = 0.01
+    cfg.train.parallel_strategy = strategy
+    cfg.train.min_shard_elems = 1
+    mk = dict(vocab_size=64, d_model=32, n_layers=2, n_heads=4,
+              max_seq_len=16, dtype="float32")
+    mk.update(model_kwargs or {})
+    model = Transformer(TransformerConfig(**mk))
+    ds = SyntheticLMDataset(size=16, seq_len=16, vocab_size=64, seed=0)
+    loader = ShardedDataLoader(ds, rt, batch_size=2, shuffle=False)
+    trainer = Trainer(cfg, rt, model, loader)
+    return ([float(trainer.train_step(b)["loss"])
+             for b in loader.epoch(0)][:steps], trainer)
+
+
+def test_tp_matches_ddp_losses():
+    """mesh (dp=2, tp=4) with Megatron-style sharding == plain dp=2."""
+    ddp_losses, _ = run_losses(fake_cpu_runtime(2), "ddp")
+    tp_losses, trainer = run_losses(fake_cpu_runtime(8, tp=4), "tp")
+    np.testing.assert_allclose(ddp_losses, tp_losses, rtol=1e-5,
+                               atol=1e-6)
+    # and TP actually sharded something over 'tp'
+    specs = jax.tree.leaves(
+        trainer.strategy.specs_for_tree(
+            jax.eval_shape(trainer.model.init, trainer.init_rng),
+            trainer.model.logical_axes()),
+        is_leaf=lambda x: True)
+    assert any("tp" in str(s) for s in specs)
+
+
+def test_ep_moe_matches_ddp_losses():
+    """MoE experts sharded over the fsdp axis (expert parallelism) == the
+    same MoE replicated under ddp."""
+    mk = dict(moe_num_experts=4, moe_top_k=2)
+    # both meshes expose 8 data shards (dp=8 vs dp=2 x fsdp=4) so the
+    # global batches are identical and only the layout differs
+    ddp_losses, _ = run_losses(fake_cpu_runtime(8), "ddp",
+                               model_kwargs=mk)
+    ep_losses, trainer = run_losses(fake_cpu_runtime(8, fsdp=4), "fsdp",
+                                    model_kwargs=mk)
+    np.testing.assert_allclose(ddp_losses, ep_losses, rtol=1e-5,
+                               atol=1e-6)
+    # expert dim is sharded: the wi (L, E, D, F) leaf routes E -> fsdp
+    specs = trainer.strategy.specs_for_tree(
+        jax.eval_shape(trainer.model.init, trainer.init_rng),
+        trainer.model.logical_axes())
+    assert "fsdp" in str(specs["mlp"]["wi"])
+
+
+def test_tp_with_gqa_kv_heads():
+    """kv-head sharding under TP requires n_kv_heads % tp == 0; with
+    n_kv_heads=2 and tp=2 it shards, with tp=4 it prunes to replicated
+    instead of crashing."""
+    strat2 = get_strategy("tp", fake_cpu_runtime(8, tp=2).spec,
+                          min_shard_elems=1)
+    spec = strat2.param_spec((2, 32, 2, 8), (None, "embed", "kv", None))
+    assert "tp" in str(spec)
+    strat4 = get_strategy("tp", fake_cpu_runtime(8, tp=4).spec,
+                          min_shard_elems=1)
+    spec = strat4.param_spec((2, 32, 2, 8), (None, "embed", "kv", None))
+    assert "tp" not in str(spec)
+
+
+@pytest.mark.parametrize("strategy,axes", [("fsdp", {"fsdp": 8}),
+                                           ("tp", {"tp": 2, "fsdp": 2})])
+def test_moe_trains_under_layouts(strategy, axes):
+    rt = fake_cpu_runtime(8, **axes)
+    losses, _ = run_losses(rt, strategy,
+                           model_kwargs=dict(moe_num_experts=4))
+    assert all(np.isfinite(l) for l in losses)
