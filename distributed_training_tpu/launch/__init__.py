@@ -1,0 +1,15 @@
+"""Launch layer (L4): process fan-out + rendezvous wiring.
+
+TPU-native replacement for the reference's torchrun/mp.spawn launch
+path (reference: cloud-init.tftpl:59-78 computes per-node torchrun
+invocations; src/playground/ddp_script.py:254-256 uses ``mp.spawn``).
+On a TPU pod nothing here is needed — every host runs the same binary
+and ``jax.distributed.initialize`` self-organises — so this module's
+job is the *local simulation* path: spawning N host-processes on one
+machine with an explicit coordinator, the framework's analogue of the
+reference's Gloo/CPU cluster simulation (SURVEY.md §4.1).
+"""
+
+from distributed_training_tpu.launch.local import (  # noqa: F401
+    LocalProcess, launch_local, main,
+)

@@ -1,0 +1,3 @@
+from distributed_training_tpu.launch.local import main
+
+raise SystemExit(main())

@@ -1,0 +1,156 @@
+"""Local multi-process launcher.
+
+Spawns ``num_processes`` OS processes on this machine, each a full
+"host" in a ``jax.distributed`` cluster rendezvousing at a local TCP
+coordinator — the counterpart of ``mp.spawn(train, nprocs=ws)`` +
+``MASTER_ADDR=localhost:12355`` in the reference playground
+(src/playground/ddp_script.py:39-48,254-256) and of torchrun's local
+mode. Each child gets ``DTT_COORDINATOR`` / ``DTT_NUM_PROCESSES`` /
+``DTT_PROCESS_ID``, which ``runtime._maybe_init_distributed`` consumes.
+
+Children default to the CPU platform with a configurable number of fake
+devices per process, so an 8-"chip" 2-host pod is simulated as
+``launch_local(["-m", "distributed_training_tpu.train"], 2,
+devices_per_process=4)`` on any machine.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import socket
+import subprocess
+import sys
+import time
+from dataclasses import dataclass
+
+logger = logging.getLogger(__name__)
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@dataclass
+class LocalProcess:
+    process_id: int
+    proc: subprocess.Popen
+    log_path: str | None
+
+
+def launch_local(
+    argv: list[str],
+    num_processes: int,
+    devices_per_process: int = 1,
+    log_dir: str | None = None,
+    env: dict[str, str] | None = None,
+    coordinator_port: int | None = None,
+) -> list[LocalProcess]:
+    """Spawn the local process group; returns handles (non-blocking).
+
+    ``argv`` is everything after ``python`` (e.g. ``["-m",
+    "distributed_training_tpu.train", "train.total_epochs=2"]``).
+    Per-process logs go to ``log_dir/proc_<i>.log`` when given —
+    mirroring the reference playground's per-rank log files
+    (ddp_script.py:74).
+    """
+    port = coordinator_port or _free_port()
+    procs: list[LocalProcess] = []
+    for pid in range(num_processes):
+        child_env = dict(os.environ)
+        child_env.update(env or {})
+        platform = (env or {}).get("JAX_PLATFORMS", "cpu")
+        child_env.update({
+            "DTT_COORDINATOR": f"127.0.0.1:{port}",
+            "DTT_NUM_PROCESSES": str(num_processes),
+            "DTT_PROCESS_ID": str(pid),
+            "JAX_PLATFORMS": platform,
+            "XLA_FLAGS": (
+                child_env.get("XLA_FLAGS", "")
+                + f" --xla_force_host_platform_device_count="
+                  f"{devices_per_process}").strip(),
+        })
+        if platform == "cpu":
+            # Hardware plugins registered by site customizations at
+            # interpreter startup would steal the platform from the
+            # simulated hosts; make sure children stay on CPU.
+            for var in ("PALLAS_AXON_POOL_IPS", "TPU_SKIP_MDS_QUERY"):
+                child_env.pop(var, None)
+        log_path = None
+        stdout = None
+        if log_dir is not None:
+            os.makedirs(log_dir, exist_ok=True)
+            log_path = os.path.join(log_dir, f"proc_{pid}.log")
+            stdout = open(log_path, "w")
+        try:
+            proc = subprocess.Popen(
+                [sys.executable, *argv], env=child_env,
+                stdout=stdout,
+                stderr=subprocess.STDOUT if stdout else None)
+        finally:
+            if stdout is not None:
+                stdout.close()  # child holds its own descriptor
+        procs.append(LocalProcess(pid, proc, log_path))
+    return procs
+
+
+def wait(procs: list[LocalProcess], timeout: float | None = None) -> int:
+    """Wait for all processes; kill the group on first failure (the
+    fail-fast behavior torchrun provides). Returns max exit code."""
+    deadline = None if timeout is None else time.monotonic() + timeout
+    pending = list(procs)
+    worst = 0
+    while pending:
+        for lp in list(pending):
+            budget = None
+            if deadline is not None:
+                budget = max(0.0, deadline - time.monotonic())
+            try:
+                code = lp.proc.wait(timeout=0.2 if budget is None
+                                    else min(0.2, budget or 0.01))
+            except subprocess.TimeoutExpired:
+                if deadline is not None and time.monotonic() >= deadline:
+                    for other in pending:
+                        other.proc.kill()
+                    raise TimeoutError(
+                        f"local launch timed out after {timeout}s; "
+                        f"pending={[p.process_id for p in pending]}")
+                continue
+            pending.remove(lp)
+            if code != 0 and worst == 0:
+                # Signal deaths are negative Popen returncodes; report
+                # them as failures, not max(0, -11) == 0.
+                worst = code if code > 0 else 128 - code
+            if code != 0:
+                logger.error(
+                    "process %d exited %d%s — killing group",
+                    lp.process_id, code,
+                    f" (log: {lp.log_path})" if lp.log_path else "")
+                for other in pending:
+                    other.proc.kill()
+    return worst
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="dtt-launch-local",
+        description="Simulate a multi-host TPU pod with local processes")
+    p.add_argument("--nproc", type=int, default=2)
+    p.add_argument("--devices-per-proc", type=int, default=4)
+    p.add_argument("--log-dir", default="outputs/local_launch")
+    p.add_argument("cmd", nargs=argparse.REMAINDER,
+                   help="-- followed by the python argv to run")
+    args = p.parse_args(argv)
+    cmd = [c for c in args.cmd if c != "--"]
+    if not cmd:
+        cmd = ["-m", "distributed_training_tpu.train"]
+    procs = launch_local(cmd, args.nproc, args.devices_per_proc,
+                         log_dir=args.log_dir)
+    return wait(procs)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

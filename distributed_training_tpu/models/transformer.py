@@ -25,6 +25,7 @@ framework's own Model protocol.
 from __future__ import annotations
 
 import dataclasses
+import math
 from dataclasses import dataclass
 
 import jax
@@ -316,9 +317,16 @@ class Transformer:
                     body, (xb, jnp.zeros((), jnp.float32)), stage_params)
                 return xb, aux
 
-            # largest microbatch count <= pp_microbatches dividing B
+            # Largest microbatch count <= pp_microbatches such that the
+            # per-microbatch batch B/M still splits evenly over the
+            # data-sharded mesh axes (shard_map requires it).
+            shards = 1
+            if self.mesh is not None:
+                sizes = dict(zip(self.mesh.axis_names,
+                                 self.mesh.devices.shape))
+                shards = math.prod(sizes.get(a, 1) for a in BATCH_AXES)
             M = max(m for m in range(1, min(c.pp_microbatches, B) + 1)
-                    if B % m == 0)
+                    if B % m == 0 and (B // m) % shards == 0)
             x, aux = pipeline_apply(
                 stage_body, stacked, x, self.mesh,
                 num_microbatches=M, batch_axes=BATCH_AXES)

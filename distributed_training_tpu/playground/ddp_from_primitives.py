@@ -76,8 +76,6 @@ def make_dataset(size: int = 1000, in_dim: int = 10, seed: int = 0):
 def _rank_step(params, x_local, y_local, lr, *, log_norms):
     """What ONE rank does for one batch. Runs under shard_map: shapes
     here are per-device shards and collectives are explicit."""
-    rank = jax.lax.axis_index("dp")
-
     # (4) local forward/backward…
     loss, grads = jax.value_and_grad(mse_loss)(params, x_local, y_local)
 
@@ -91,7 +89,6 @@ def _rank_step(params, x_local, y_local, lr, *, log_norms):
     # (5) identical SGD step on every rank — replicas stay in lockstep.
     params = jax.tree.map(lambda p, g: p - lr * g, params, grads)
 
-    del rank
     # Per-rank values get a leading length-1 axis so shard_map can
     # concatenate them over 'dp' (out_specs P('dp')) — without that they
     # would collapse to one undefined replica's value at the boundary.
@@ -201,13 +198,18 @@ def _write_rank_logs(log_dir, epoch, step, metrics, world_size):
     local = np.asarray(metrics["local_loss"])
     gnorms = {k: np.asarray(v) for k, v in
               _flatten(metrics.get("grad_norms", {})).items()}
+    pnorms = {k: np.asarray(v) for k, v in
+              _flatten(metrics.get("param_norms", {})).items()}
     for r in range(world_size):
         path = os.path.join(log_dir, f"ddp_rank_{r}.log")
         norm_txt = " ".join(f"|g[{k}]|={v[r]:.4f}"
                             for k, v in gnorms.items())
+        wnorm_txt = " ".join(f"|w[{k}]|={v[r]:.4f}"
+                             for k, v in pnorms.items())
         with open(path, "a") as f:
             f.write(f"epoch={epoch} step={step} "
-                    f"local_loss={local[r]:.6f} {norm_txt}\n")
+                    f"local_loss={local[r]:.6f} {norm_txt} "
+                    f"{wnorm_txt}\n")
 
 
 def _flatten(tree, prefix=""):

@@ -1,0 +1,32 @@
+#!/usr/bin/env bash
+# Launch (or re-launch) training on every host of an existing TPU pod.
+#
+# The torchrun-replacement: where the reference's bootstrap computes
+# --node_rank/--master_addr per node and runs torchrun with 8 procs/host
+# (cloud-init.tftpl:59-78), a TPU pod runs ONE process per host with the
+# SAME command line; rendezvous is automatic. `gcloud ... --worker=all`
+# is the fan-out.
+#
+# Usage: launch.sh POD_NAME ZONE [config overrides...]
+#   launch.sh dtt-pod us-central2-b 'train.parallel_strategy=fsdp model=transformer_1b'
+set -euo pipefail
+
+POD="${1:?usage: launch.sh POD_NAME ZONE [overrides]}"
+ZONE="${2:?usage: launch.sh POD_NAME ZONE [overrides]}"
+shift 2
+OVERRIDES="$*"
+
+REPO_DIR=/opt/distributed_training_tpu
+
+gcloud compute tpus tpu-vm ssh "$POD" --zone "$ZONE" --worker=all --command "
+  set -e
+  cd $REPO_DIR
+  pkill -f multigpu_multi_node.py || true
+  export DTT_AUTO_DISTRIBUTED=1
+  nohup ./.venv/bin/python multigpu_multi_node.py $OVERRIDES \
+    > /var/log/dtt-train.log 2>&1 &
+  echo launched on \$(hostname)
+"
+
+echo "tail logs with:"
+echo "  gcloud compute tpus tpu-vm ssh $POD --zone $ZONE --worker=0 --command 'tail -f /var/log/dtt-train.log'"

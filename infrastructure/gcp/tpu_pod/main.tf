@@ -1,0 +1,79 @@
+# TPU pod slice + shared GCS bucket.
+#
+# TPU-native counterpart of the reference's Nebius H100 cluster
+# (reference: infrastructure/nebius/cluster/main.tf): where the reference
+# provisions N GPU VMs, an InfiniBand fabric, and a virtiofs shared
+# filesystem, a TPU pod slice is ONE resource — the ICI interconnect comes
+# with the slice (no fabric resource to manage), every host runs the same
+# startup script (no master/worker asymmetry: `jax.distributed.initialize`
+# auto-detects the coordinator from the TPU metadata server, replacing the
+# reference's torchrun rendezvous + worker nc-probe loop,
+# cloud-init.tftpl:18-32,61-77), and a GCS bucket replaces the shared
+# NETWORK_SSD filesystem (cluster/main.tf:36-42) for checkpoints and logs.
+
+locals {
+  startup_script = templatefile("${path.module}/scripts/startup.sh.tftpl", {
+    repo_url       = var.repo_url
+    repo_branch    = var.repo_branch
+    gcs_bucket     = google_storage_bucket.shared.name
+    train_args     = var.train_args
+    auto_start     = var.auto_start_training
+  })
+}
+
+# Shared storage for checkpoints, resolved configs, and logs — the
+# analogue of the reference's 100 GiB shared filesystem. Orbax writes
+# sharded checkpoints here directly (gs:// paths), so no mount step is
+# needed on the hosts.
+resource "google_storage_bucket" "shared" {
+  name                        = "${var.name_prefix}-shared-${var.project_id}"
+  location                    = var.gcs_location
+  force_destroy               = var.gcs_force_destroy
+  uniform_bucket_level_access = true
+
+  lifecycle_rule {
+    condition {
+      num_newer_versions = var.checkpoint_versions_to_keep
+    }
+    action {
+      type = "Delete"
+    }
+  }
+  versioning {
+    enabled = true
+  }
+}
+
+# The pod slice. accelerator_type encodes the whole topology (v4-32 =
+# 16 chips / 4 hosts); there is no per-node resource to replicate the
+# way the reference loops over worker instances (cluster/main.tf:96-141).
+resource "google_tpu_v2_vm" "pod" {
+  name             = "${var.name_prefix}-pod"
+  zone             = var.zone
+  accelerator_type = var.accelerator_type
+  runtime_version  = var.runtime_version
+
+  network_config {
+    network            = var.network
+    enable_external_ips = var.enable_external_ips
+  }
+
+  scheduling_config {
+    preemptible = var.preemptible
+  }
+
+  metadata = {
+    # Runs on EVERY host of the slice (same binary everywhere — SPMD at
+    # the infrastructure level too).
+    startup-script = local.startup_script
+  }
+
+  service_account {
+    email = var.service_account_email
+    scope = ["https://www.googleapis.com/auth/cloud-platform"]
+  }
+
+  labels = {
+    purpose = "distributed-training-tpu"
+  }
+}

@@ -1,0 +1,26 @@
+# Counterpart of the reference's cluster outputs (outputs.tf there exports
+# master/worker IPs for ssh + torchrun); here the useful handles are the
+# pod name (gcloud ssh target), per-host endpoints, and the shared bucket.
+
+output "pod_name" {
+  description = "TPU pod resource name — the --worker=all ssh target."
+  value       = google_tpu_v2_vm.pod.name
+}
+
+output "network_endpoints" {
+  description = "Per-host internal IPs of the slice."
+  value       = google_tpu_v2_vm.pod.network_endpoints
+}
+
+output "shared_bucket" {
+  description = "GCS bucket for checkpoints/logs (shared-fs analogue)."
+  value       = "gs://${google_storage_bucket.shared.name}"
+}
+
+output "launch_hint" {
+  description = "How to start / watch a run."
+  value = join(" ", [
+    "./scripts/launch.sh", google_tpu_v2_vm.pod.name, var.zone,
+    "'train.parallel_strategy=fsdp model=transformer_1b'",
+  ])
+}

@@ -1,0 +1,111 @@
+# Inputs for the TPU pod deployment (counterpart of the reference's
+# cluster variables, infrastructure/nebius/cluster/variables.tf — but the
+# scale knob is the slice topology, not a VM count: cluster_size there,
+# accelerator_type here).
+
+variable "project_id" {
+  description = "GCP project to deploy into."
+  type        = string
+}
+
+variable "zone" {
+  description = "Zone with TPU capacity (e.g. us-central2-b for v4)."
+  type        = string
+  default     = "us-central2-b"
+}
+
+variable "name_prefix" {
+  description = "Prefix for all created resources."
+  type        = string
+  default     = "dtt"
+}
+
+variable "accelerator_type" {
+  description = <<-EOT
+    TPU slice topology. v4-32 (16 chips, 4 hosts) is the BASELINE.json
+    target; v4-8 for a single-host slice. Replaces the reference's
+    cluster_size count of 8-GPU nodes.
+  EOT
+  type        = string
+  default     = "v4-32"
+
+  validation {
+    condition     = can(regex("^v[0-9]+[a-z]*-[0-9]+$", var.accelerator_type))
+    error_message = "accelerator_type must look like v4-32 / v5litepod-16."
+  }
+}
+
+variable "runtime_version" {
+  description = "TPU VM runtime image."
+  type        = string
+  default     = "tpu-ubuntu2204-base"
+}
+
+variable "network" {
+  description = "VPC network name."
+  type        = string
+  default     = "default"
+}
+
+variable "enable_external_ips" {
+  description = "Give hosts external IPs (needed to git clone without NAT)."
+  type        = bool
+  default     = true
+}
+
+variable "preemptible" {
+  description = <<-EOT
+    Use preemptible capacity. Safe because training is checkpoint/resume
+    based (save_every epochs to GCS; resume-if-exists on restart) — the
+    idiomatic TPU failure-recovery model (SURVEY.md §5.3).
+  EOT
+  type        = bool
+  default     = false
+}
+
+variable "service_account_email" {
+  description = "Service account for the TPU VMs (needs GCS read/write)."
+  type        = string
+  default     = null
+}
+
+variable "gcs_location" {
+  description = "Bucket location; keep in the same region as the TPUs."
+  type        = string
+  default     = "US-CENTRAL2"
+}
+
+variable "gcs_force_destroy" {
+  description = "Allow terraform destroy to delete a non-empty bucket."
+  type        = bool
+  default     = false
+}
+
+variable "checkpoint_versions_to_keep" {
+  description = "Object versions retained per checkpoint file."
+  type        = number
+  default     = 3
+}
+
+variable "repo_url" {
+  description = "Git URL of this framework, cloned by every host."
+  type        = string
+}
+
+variable "repo_branch" {
+  description = "Branch/tag to check out."
+  type        = string
+  default     = "main"
+}
+
+variable "train_args" {
+  description = "Config overrides passed to the trainer (key=value ...)."
+  type        = string
+  default     = ""
+}
+
+variable "auto_start_training" {
+  description = "Start training from the startup script; if false, hosts come up idle and `launch.sh` starts runs on demand."
+  type        = bool
+  default     = true
+}

@@ -1,0 +1,43 @@
+# Single-host TPU VM for development / single-device runs.
+#
+# Counterpart of the reference's single-GPU deployment
+# (infrastructure/nebius/single_gpu/main.tf). Unlike the reference —
+# whose single-GPU cloud-init left the training command commented out
+# against a nonexistent entrypoint (single_gpu cloud-init.tftpl:34-35;
+# SURVEY.md §8 B1) — this one launches the real entrypoint, idle by
+# default via auto_start_training=false.
+
+locals {
+  startup_script = templatefile(
+    "${path.module}/../tpu_pod/scripts/startup.sh.tftpl", {
+      repo_url    = var.repo_url
+      repo_branch = var.repo_branch
+      gcs_bucket  = var.gcs_bucket
+      train_args  = var.train_args
+      auto_start  = var.auto_start_training
+    })
+}
+
+resource "google_tpu_v2_vm" "dev" {
+  name             = "${var.name_prefix}-dev"
+  zone             = var.zone
+  accelerator_type = var.accelerator_type
+  runtime_version  = var.runtime_version
+
+  network_config {
+    network             = var.network
+    enable_external_ips = true
+  }
+
+  metadata = {
+    startup-script = local.startup_script
+  }
+
+  labels = {
+    purpose = "distributed-training-tpu-dev"
+  }
+}
+
+output "vm_name" {
+  value = google_tpu_v2_vm.dev.name
+}

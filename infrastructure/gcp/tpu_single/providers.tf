@@ -1,0 +1,8 @@
+# Auth via Application Default Credentials (`gcloud auth application-default
+# login`) or GOOGLE_APPLICATION_CREDENTIALS — env-based like the reference's
+# Nebius service-account setup (providers.tf there), no secrets in state.
+
+provider "google" {
+  project = var.project_id
+  zone    = var.zone
+}

@@ -1,0 +1,53 @@
+variable "project_id" {
+  type = string
+}
+
+variable "zone" {
+  type    = string
+  default = "us-central2-b"
+}
+
+variable "name_prefix" {
+  type    = string
+  default = "dtt"
+}
+
+variable "accelerator_type" {
+  description = "Single-host slice (v4-8 = 4 chips on one host)."
+  type        = string
+  default     = "v4-8"
+}
+
+variable "runtime_version" {
+  type    = string
+  default = "tpu-ubuntu2204-base"
+}
+
+variable "network" {
+  type    = string
+  default = "default"
+}
+
+variable "gcs_bucket" {
+  description = "Existing bucket for checkpoints/logs (no bucket is created here; point at the tpu_pod one or any other)."
+  type        = string
+}
+
+variable "repo_url" {
+  type = string
+}
+
+variable "repo_branch" {
+  type    = string
+  default = "main"
+}
+
+variable "train_args" {
+  type    = string
+  default = ""
+}
+
+variable "auto_start_training" {
+  type    = bool
+  default = false
+}

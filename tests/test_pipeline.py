@@ -161,3 +161,20 @@ def test_pp_microbatch_autodivisor():
     loss, _ = jax.jit(lambda p, b: model.loss(p, b, jax.random.PRNGKey(0)))(
         params, {"tokens": tokens})
     assert np.isfinite(float(loss))
+
+
+def test_pp_microbatch_autodivisor_respects_data_shards():
+    """Regression: B=4 on a dp=2,pp=2 mesh with pp_microbatches=4 must
+    pick M=2 (per-microbatch batch stays divisible by the dp shard
+    count), not M=4 (which makes shard_map reject batch dim 1)."""
+    rt = fake_cpu_runtime(4, pp=2, dp=2)
+    model = Transformer(TransformerConfig(
+        vocab_size=64, d_model=32, n_layers=4, n_heads=4,
+        max_seq_len=16, dtype="float32", attention_impl="naive",
+        pp_microbatches=4))
+    model.bind_mesh(rt.mesh)
+    params = jax.jit(model.init)(jax.random.PRNGKey(0))
+    tokens = jnp.zeros((4, 9), jnp.int32)
+    loss, _ = jax.jit(lambda p, b: model.loss(p, b, jax.random.PRNGKey(0)))(
+        params, {"tokens": tokens})
+    assert np.isfinite(float(loss))
