@@ -82,8 +82,19 @@ def main(argv: list[str] | None = None) -> int:
                         dtype=model_dtype, **model_kwargs)
     checkpointer = Checkpointer(cfg.train.snapshot_path)
 
-    trainer = Trainer(cfg, rt, model, loader, checkpointer)
-    summary = trainer.train()
+    from distributed_training_tpu.utils.preemption import PreemptionGuard
+    guard = PreemptionGuard.install()
+
+    trainer = Trainer(cfg, rt, model, loader, checkpointer,
+                      preemption_guard=guard)
+    if cfg.train.profile_dir:
+        from distributed_training_tpu.utils import profiler
+        with profiler.trace(cfg.train.profile_dir,
+                            host_only_on_coordinator=True,
+                            process_index=rt.process_index):
+            summary = trainer.train()
+    else:
+        summary = trainer.train()
     if rt.is_coordinator:
         logger.info("training done: %s", summary)
     checkpointer.close()

@@ -83,12 +83,15 @@ class Trainer:
     """Config-driven training orchestrator."""
 
     def __init__(self, cfg: Config, runtime: Runtime, model,
-                 loader, checkpointer=None):
+                 loader, checkpointer=None, preemption_guard=None):
         self.cfg = cfg
         self.rt = runtime
         self.model = model
         self.loader = loader
         self.checkpointer = checkpointer
+        # Cooperative stop flag (SIGTERM → save + clean exit); see
+        # utils/preemption.py. None → never stops early.
+        self.preemption_guard = preemption_guard
         tcfg = cfg.train
 
         from distributed_training_tpu.parallel import get_strategy
@@ -157,6 +160,52 @@ class Trainer:
             device_kind=runtime.device_kind,
         )
 
+    # -- cooperative stop / health ----------------------------------------
+
+    _stop_agreed: bool = False
+
+    def _agreed_stop(self) -> bool:
+        """Whether to break the step loop — agreed across ALL hosts.
+
+        The local SIGTERM flag alone is not enough on a multi-host pod:
+        the signal lands at different loop points on different hosts, and
+        a host that breaks while others dispatch the next compiled step
+        deadlocks the SPMD program (its collectives wait forever). So
+        every host contributes its flag to a host-level allgather at the
+        same loop point and all act on the OR."""
+        if self.preemption_guard is None:
+            return False
+        local = self.preemption_guard.should_stop
+        if self.rt.process_count > 1:
+            from jax.experimental import multihost_utils
+            flags = multihost_utils.process_allgather(
+                np.asarray([local], dtype=np.bool_))
+            local = bool(np.asarray(flags).any())
+        self._stop_agreed = local
+        return local
+
+    def _check_divergence(self):
+        """Replica-drift check over axes the params are replicated on
+        (DDP: (dp, fsdp); FSDP/TP: dp only — shards are fingerprinted in
+        place, no all-gather). None if the layout has no replicas."""
+        from jax.sharding import PartitionSpec
+        from distributed_training_tpu.runtime import BATCH_AXES
+        from distributed_training_tpu.utils import diagnostics
+        specs = jax.tree.map(lambda s: s.spec,
+                             self.state_shardings["params"])
+        used = {a for s in jax.tree.leaves(
+            specs, is_leaf=lambda x: isinstance(x, PartitionSpec))
+            for part in s if part is not None
+            for a in ((part,) if isinstance(part, str) else part)}
+        sizes = self.rt.spec.as_dict()
+        axes = tuple(a for a in BATCH_AXES
+                     if a not in used and sizes.get(a, 1) > 1)
+        if not axes:
+            return None
+        return diagnostics.replica_divergence(
+            self.state["params"], self.rt.mesh, axes=axes,
+            param_specs=specs)
+
     # -- loops -------------------------------------------------------------
 
     def train_step(self, batch) -> Mapping[str, jax.Array]:
@@ -170,10 +219,20 @@ class Trainer:
         — sampler reshuffle per epoch, batch loop — without the
         wasted peek-batch (§8 B3)."""
         losses = []
+        div_every = self.cfg.train.divergence_check_every
         for batch in self.loader.epoch(epoch):
             metrics = self.train_step(batch)
+            if div_every and self.global_step % div_every == 0:
+                # Compiled cross-replica drift check (SURVEY.md §5.2's
+                # "diff the rank logs", formalized).
+                report = self._check_divergence()
+                if report is not None:
+                    metrics = {**metrics, "replica_divergence":
+                               report["max_divergence"]}
             self.metrics.record(self.global_step, metrics, epoch=epoch)
             losses.append(metrics["loss"])
+            if self._agreed_stop():
+                break
         # One host sync per epoch, not per step.
         mean_loss = float(np.mean([float(l) for l in losses]))
         return {"epoch": epoch, "mean_loss": mean_loss}
@@ -188,12 +247,21 @@ class Trainer:
             if self.rt.is_coordinator:
                 logger.info("epoch %d | mean_loss %.6f", epoch,
                             summary["mean_loss"])
-            if (self.checkpointer is not None
-                    and epoch % self.cfg.train.save_every == 0):
+            preempted = self._stop_agreed
+            if self.checkpointer is not None and (
+                    preempted or epoch % self.cfg.train.save_every == 0):
                 # Collective save: every process participates (fixes the
                 # reference's rank-0-only FSDP save hang, SURVEY.md §8 B6).
+                # On preemption: save whatever we have, mid-epoch
+                # included (resume re-runs the interrupted epoch).
                 self.checkpointer.save(
-                    self.global_step, self.state, meta={"epoch": epoch})
+                    self.global_step, self.state,
+                    meta={"epoch": epoch if not preempted else epoch - 1},
+                    force=preempted)
+            if preempted:
+                logger.warning("stopping at epoch %d due to preemption",
+                               epoch)
+                break
             self.epochs_run = epoch + 1
         if self.checkpointer is not None:
             self.checkpointer.wait()

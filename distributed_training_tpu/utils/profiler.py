@@ -1,0 +1,74 @@
+"""Tracing / profiling subsystem.
+
+The reference has none — no torch profiler, no NVTX, no TensorBoard
+(SURVEY.md §5.1); the closest artifact is log timestamps
+(src/distributed_trainer.py:221-224). On TPU the platform profiler is
+``jax.profiler``: traces capture XLA op timelines, HBM usage, and ICI
+collective activity, viewable in TensorBoard/Perfetto/XProf. This module
+wraps it with the two idioms a trainer needs — a bounded step-window
+trace and an on-demand trace server — plus annotation helpers.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import os
+
+import jax
+
+logger = logging.getLogger(__name__)
+
+
+def start_server(port: int = 9999) -> None:
+    """Expose the live profiler (``jax.profiler.start_server``) so
+    TensorBoard / XProf can capture a trace from a running job on
+    demand — the production idiom for multi-host pods (capture on any
+    worker while training runs)."""
+    jax.profiler.start_server(port)
+    logger.info("profiler server listening on port %d", port)
+
+
+@contextlib.contextmanager
+def trace(logdir: str, host_only_on_coordinator: bool = False,
+          process_index: int = 0):
+    """Trace everything inside the block to ``logdir``.
+
+    On multi-host runs every process traces its own devices; pass
+    ``host_only_on_coordinator=True`` to trace just process 0 (smaller
+    artifacts, usually enough to diagnose a step)."""
+    if host_only_on_coordinator and process_index != 0:
+        yield
+        return
+    os.makedirs(logdir, exist_ok=True)
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+        logger.info("profiler trace written to %s", logdir)
+
+
+def annotate(name: str):
+    """Named region in the trace timeline (host + device)."""
+    return jax.profiler.TraceAnnotation(name)
+
+
+def trace_steps(trainer, batches, logdir: str, warmup: int = 2) -> int:
+    """Profile a short step window: run ``warmup`` steps uncaptured
+    (compile + cache), then trace the remaining batches. Returns the
+    number of traced steps."""
+    it = iter(batches)
+    done = 0
+    for _ in range(warmup):
+        try:
+            trainer.train_step(next(it))
+        except StopIteration:
+            break
+    with trace(logdir):
+        for batch in it:
+            metrics = trainer.train_step(batch)
+            done += 1
+        if done:
+            jax.block_until_ready(metrics["loss"])
+    return done

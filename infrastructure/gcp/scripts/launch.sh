@@ -18,13 +18,17 @@ OVERRIDES="$*"
 
 REPO_DIR=/opt/distributed_training_tpu
 
+# sudo throughout: the startup script ran as root, so the previous
+# training process and /var/log/dtt-train.log are root-owned — an
+# unprivileged pkill would silently fail and the log redirect would
+# permission-error inside the background subshell.
 gcloud compute tpus tpu-vm ssh "$POD" --zone "$ZONE" --worker=all --command "
   set -e
   cd $REPO_DIR
-  pkill -f multigpu_multi_node.py || true
-  export DTT_AUTO_DISTRIBUTED=1
-  nohup ./.venv/bin/python multigpu_multi_node.py $OVERRIDES \
-    > /var/log/dtt-train.log 2>&1 &
+  sudo pkill -f multigpu_multi_node.py || true
+  sudo env DTT_AUTO_DISTRIBUTED=1 \
+    sh -c 'nohup ./.venv/bin/python multigpu_multi_node.py $OVERRIDES \
+      > /var/log/dtt-train.log 2>&1 &'
   echo launched on \$(hostname)
 "
 
