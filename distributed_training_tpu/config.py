@@ -70,6 +70,11 @@ class TrainConfig:
     nan_guard: bool = False       # skip+log non-finite update steps
     min_shard_elems: int = 4096   # FSDP: replicate arrays smaller than this
     divergence_check_every: int = 0  # steps; 0 disables replica-drift check
+    # Steps between cross-host stop-flag polls (multi-host only). Stop
+    # latency on SIGTERM is stop_poll_every * step_time — keep that
+    # below the preemption grace window (~30s on GCE); use 1 for steps
+    # slower than a few seconds.
+    stop_poll_every: int = 8
     profile_dir: str = ""         # non-empty → jax.profiler traces here
 
 

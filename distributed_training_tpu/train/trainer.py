@@ -176,13 +176,24 @@ class Trainer:
         if self.preemption_guard is None:
             return False
         local = self.preemption_guard.should_stop
-        if self.rt.process_count > 1:
+        if self.rt.process_count == 1:
+            self._stop_agreed = local
+            return local
+        # Multi-host: the allgather blocks the host thread, so polling
+        # every step would break async dispatch. Poll on a step cadence
+        # instead — the condition must be a function of global_step (in
+        # lockstep on every host), NOT of the local flag or a local
+        # clock, or hosts would enter the collective at different loop
+        # points and deadlock. Stop latency is stop_poll_every ×
+        # step_time; it must fit the preemption grace window, so for
+        # slow steps set stop_poll_every=1 (see config).
+        poll = max(1, self.cfg.train.stop_poll_every)
+        if self.global_step % poll == 0:
             from jax.experimental import multihost_utils
             flags = multihost_utils.process_allgather(
                 np.asarray([local], dtype=np.bool_))
-            local = bool(np.asarray(flags).any())
-        self._stop_agreed = local
-        return local
+            self._stop_agreed = bool(np.asarray(flags).any())
+        return self._stop_agreed
 
     def _check_divergence(self):
         """Replica-drift check over axes the params are replicated on

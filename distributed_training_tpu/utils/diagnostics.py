@@ -124,12 +124,16 @@ def replica_divergence(params: Any, mesh: Mesh,
 
 
 def check_finite(tree: Any) -> dict:
-    """Host-side NaN/Inf report: fraction of non-finite entries per
-    leaf; empty dict means all finite."""
+    """Host-side NaN/Inf report: count of non-finite entries per leaf;
+    empty dict means all finite. Summing the (rare) non-finite indicator
+    in float32 is exact below 2^24 and saturates-but-stays-positive
+    above, so a poisoned leaf can never be reported clean — unlike a
+    float mean of isfinite (rounds sparse NaNs in big leaves to 0) or an
+    int32 sum (wraps past 2^31, possibly to <=0)."""
     flat, _ = jax.tree_util.tree_flatten_with_path(
-        jax.tree.map(lambda x: 1.0 - jnp.mean(
-            jnp.isfinite(x.astype(jnp.float32))), tree))
-    bad = {jax.tree_util.keystr(path): float(v)
+        jax.tree.map(lambda x: jnp.sum(
+            (~jnp.isfinite(x)).astype(jnp.float32)), tree))
+    bad = {jax.tree_util.keystr(path): int(v)
            for path, v in flat if float(v) > 0}
     if bad:
         logger.error("non-finite values: %s", bad)

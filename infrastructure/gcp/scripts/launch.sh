@@ -8,27 +8,40 @@
 # is the fan-out.
 #
 # Usage: launch.sh POD_NAME ZONE [config overrides...]
-#   launch.sh dtt-pod us-central2-b 'train.parallel_strategy=fsdp model=transformer_1b'
+#   launch.sh dtt-pod us-central2-b train.parallel_strategy=fsdp model=transformer_1b
 set -euo pipefail
 
 POD="${1:?usage: launch.sh POD_NAME ZONE [overrides]}"
 ZONE="${2:?usage: launch.sh POD_NAME ZONE [overrides]}"
 shift 2
-OVERRIDES="$*"
+
+# Re-quote each override so args containing spaces or quotes survive the
+# two shell hops (local shell → remote login shell → inner root bash).
+OVERRIDES=""
+for arg in "$@"; do
+  OVERRIDES+=" $(printf '%q' "$arg")"
+done
 
 REPO_DIR=/opt/distributed_training_tpu
 
+# Step 1: stop any previous run. A SEPARATE ssh invocation from the
+# launch: the bracketed pattern cannot match this command's own argv,
+# and the launch command below (whose argv must contain the plain
+# entrypoint name) carries no pkill that could kill its own shell.
 # sudo throughout: the startup script ran as root, so the previous
-# training process and /var/log/dtt-train.log are root-owned — an
-# unprivileged pkill would silently fail and the log redirect would
-# permission-error inside the background subshell.
+# training process and /var/log/dtt-train.log are root-owned.
+gcloud compute tpus tpu-vm ssh "$POD" --zone "$ZONE" --worker=all \
+  --command "sudo pkill -f '[m]ultigpu_multi_node.py' || true"
+
+# Step 2: launch. The whole root-side line is %q-quoted locally so it
+# arrives at the remote bash as ONE argument for `bash -c`, regardless
+# of what characters the overrides contain.
+INNER="cd $REPO_DIR && nohup ./.venv/bin/python multigpu_multi_node.py$OVERRIDES > /var/log/dtt-train.log 2>&1 &"
 gcloud compute tpus tpu-vm ssh "$POD" --zone "$ZONE" --worker=all --command "
   set -e
   cd $REPO_DIR
-  sudo pkill -f multigpu_multi_node.py || true
-  sudo env DTT_AUTO_DISTRIBUTED=1 \
-    sh -c 'nohup ./.venv/bin/python multigpu_multi_node.py $OVERRIDES \
-      > /var/log/dtt-train.log 2>&1 &'
+  test -x ./.venv/bin/python
+  sudo env DTT_AUTO_DISTRIBUTED=1 bash -c $(printf '%q' "$INNER")
   echo launched on \$(hostname)
 "
 

@@ -31,29 +31,28 @@ def test_replica_divergence_zero_for_replicated(rt):
 
 
 def test_replica_divergence_detects_drift(rt):
-    """Place a deliberately different value on one dp replica via
-    device_put of distinct shards — the check must flag it."""
+    """Desynchronized replicas must be flagged by the PUBLIC
+    ``replica_divergence`` path. A nominally-replicated array whose
+    per-device buffers differ is exactly the multi-process failure mode
+    (each host materializes its own copy); build one with
+    ``make_array_from_single_device_arrays``, which trusts the caller's
+    buffers."""
     from jax.sharding import NamedSharding, PartitionSpec as P
-    # Build an array sharded over dp with unequal shard contents, then
-    # *reinterpret* it as replicated by viewing shards directly.
-    base = np.ones((8, 4), np.float32)
-    base[3] += 1e-3  # one "replica row" differs
-    arr = jax.device_put(base, NamedSharding(rt.mesh, P("dp")))
+    sharding = NamedSharding(rt.mesh, P())  # "replicated"
+    good = np.ones((4, 4), np.float32)
+    bad = good.copy()
+    bad[0, 0] += 1e-3  # one replica drifts
+    devices = list(rt.mesh.devices.flat)
+    bufs = [jax.device_put(bad if i == 3 else good, d)
+            for i, d in enumerate(devices)]
+    arr = jax.make_array_from_single_device_arrays(
+        good.shape, sharding, bufs)
 
-    # shard_map with in_specs=P("dp") hands each replica its own row —
-    # fingerprints differ across dp.
-    from jax.experimental.shard_map import shard_map
-    def fake_replicated(x):
-        return x  # per-rank (1,4) shard plays the role of its "params"
-    report_specs = {"max": None}
-
-    def spread(x):
-        f = diagnostics._fingerprint(x).astype(jnp.float32)
-        return jnp.abs(jax.lax.pmax(f, "dp") - jax.lax.pmin(f, "dp"))
-
-    fn = shard_map(spread, mesh=rt.mesh, in_specs=P("dp"),
-                   out_specs=P(), check_rep=False)
-    assert float(jax.jit(fn)(arr)) > 0
+    report = diagnostics.replica_divergence({"w": arr}, rt.mesh)
+    assert report["max_divergence"] > 0
+    assert any(v > 0 for v in report["leaves"].values())
+    with pytest.raises(AssertionError, match="diverged"):
+        diagnostics.assert_replicas_in_sync({"w": arr}, rt.mesh)
 
 
 def test_assert_replicas_in_sync_passes(rt):
