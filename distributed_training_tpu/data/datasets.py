@@ -42,7 +42,11 @@ class ArrayDataset:
         return self._size
 
     def batch(self, indices: np.ndarray) -> dict[str, np.ndarray]:
-        return {k: v[indices] for k, v in self.columns.items()}
+        # Native multithreaded row gather when compiled (exact-equal to
+        # NumPy fancy indexing; distributed_training_tpu/native).
+        from distributed_training_tpu import native
+        return {k: native.gather_rows(v, indices)
+                for k, v in self.columns.items()}
 
 
 class SyntheticRegressionDataset(ArrayDataset):
@@ -88,9 +92,14 @@ class SyntheticLMDataset(ArrayDataset):
 
     def __init__(self, size: int = 1024, seq_len: int = 128,
                  vocab_size: int = 50257, seed: int = 0):
-        rng = np.random.default_rng(seed)
-        tokens = rng.integers(0, vocab_size, (size, seq_len + 1),
-                              dtype=np.int32)
+        # Native multithreaded token fill when compiled; NumPy fallback
+        # draws a different (equally valid) stream — each is
+        # deterministic in `seed` and identical on every host, which is
+        # the property the multi-host data path relies on.
+        from distributed_training_tpu import native
+        tokens = native.fill_tokens(
+            seed, vocab_size, size * (seq_len + 1)).reshape(
+                size, seq_len + 1)
         super().__init__(tokens=tokens)
         self.seq_len = seq_len
         self.vocab_size = vocab_size

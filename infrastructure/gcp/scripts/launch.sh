@@ -24,14 +24,26 @@ done
 
 REPO_DIR=/opt/distributed_training_tpu
 
-# Step 1: stop any previous run. A SEPARATE ssh invocation from the
-# launch: the bracketed pattern cannot match this command's own argv,
-# and the launch command below (whose argv must contain the plain
-# entrypoint name) carries no pkill that could kill its own shell.
+# Step 1: stop any previous run and WAIT for it to exit. A SEPARATE ssh
+# invocation from the launch: the bracketed pattern cannot match this
+# command's own argv, and the launch command below (whose argv must
+# contain the plain entrypoint name) carries no pkill that could kill
+# its own shell. The wait matters: the trainer's preemption-aware
+# shutdown finishes the current step(s) and writes a checkpoint before
+# exiting, and until it exits it holds the TPU chips — launching over it
+# would fail device init. Escalate to SIGKILL only after the grace
+# window.
 # sudo throughout: the startup script ran as root, so the previous
 # training process and /var/log/dtt-train.log are root-owned.
-gcloud compute tpus tpu-vm ssh "$POD" --zone "$ZONE" --worker=all \
-  --command "sudo pkill -f '[m]ultigpu_multi_node.py' || true"
+gcloud compute tpus tpu-vm ssh "$POD" --zone "$ZONE" --worker=all --command "
+  sudo pkill -f '[m]ultigpu_multi_node.py' || true
+  for i in \$(seq 1 60); do
+    pgrep -f '[m]ultigpu_multi_node.py' >/dev/null || break
+    sleep 2
+  done
+  sudo pkill -9 -f '[m]ultigpu_multi_node.py' || true
+  while pgrep -f '[m]ultigpu_multi_node.py' >/dev/null; do sleep 1; done
+"
 
 # Step 2: launch. The whole root-side line is %q-quoted locally so it
 # arrives at the remote bash as ONE argument for `bash -c`, regardless
