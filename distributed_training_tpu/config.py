@@ -61,6 +61,10 @@ class TrainConfig:
     dtype: str = "float32"        # compute dtype: "float32" | "bfloat16"
     param_dtype: str = "float32"
     remat: bool = False           # gradient checkpointing for big models
+    # Microbatches accumulated per optimizer step (1 = off). The global
+    # batch must split evenly: batch_size % grad_accum_steps == 0 per
+    # shard. Peak activation memory scales with batch/grad_accum_steps.
+    grad_accum_steps: int = 1
     loss: str = "auto"            # "auto" | "mse" | "xent" | "prob_xent"
     dataset: str = "synthetic"    # data source name
     dataset_kwargs: dict[str, Any] = field(default_factory=dict)

@@ -29,6 +29,7 @@ import math
 from dataclasses import dataclass
 
 import jax
+import jax.ad_checkpoint
 import jax.numpy as jnp
 
 from distributed_training_tpu.models.base import normal_init
@@ -50,6 +51,13 @@ class TransformerConfig:
     dtype: str = "bfloat16"      # compute dtype
     param_dtype: str = "float32"
     remat: bool = False
+    # "full": jax.checkpoint over the whole block — minimal memory,
+    # recomputes everything incl. attention in the backward pass.
+    # "selective": save attention outputs (small, B*S*D) and recompute
+    # only the LN/MLP intermediates (the big B*S*4D buffers) — avoids
+    # re-running the flash-attention kernel under remat, which costs
+    # extra Pallas launches and compiles far more slowly.
+    remat_policy: str = "selective"  # "full" | "selective"
     attention_impl: str = "auto"
     pp_microbatches: int = 4      # GPipe microbatches when mesh pp > 1
     # MoE (expert-parallel): > 0 turns every MLP into a top-k routed
@@ -68,6 +76,13 @@ class TransformerConfig:
             raise ValueError("d_model must divide into n_heads")
         if self.n_heads % self.n_kv_heads:
             raise ValueError("n_heads must divide into n_kv_heads")
+        if self.remat_policy not in ("full", "selective"):
+            # Validate here (not only in the remat branch of apply) so
+            # a typo surfaces at construction even with remat=False or
+            # on pp>1 meshes that bypass the single-stack remat path.
+            raise ValueError(
+                f"unknown remat_policy '{self.remat_policy}' "
+                "(expected 'full' or 'selective')")
 
     @property
     def head_dim(self) -> int:
@@ -259,6 +274,9 @@ class Transformer:
         if c.pos_encoding == "rope":
             q, k = _rope(q, k, positions)
         attn = self._attention(q, k, v)
+        # Named so the "selective" remat policy can pin it as saved
+        # while everything else in the block rematerializes.
+        attn = jax.ad_checkpoint.checkpoint_name(attn, "attn_out")
         x = x + jnp.einsum("bshk,hkd->bsd", attn,
                            layer["attn"]["wo"].astype(dt))
 
@@ -337,7 +355,15 @@ class Transformer:
         else:
             block = body
             if c.remat:
-                block = jax.checkpoint(body, prevent_cse=False)
+                policy = None
+                if c.remat_policy == "selective":
+                    policy = jax.checkpoint_policies.\
+                        save_only_these_names("attn_out")
+                elif c.remat_policy != "full":
+                    raise ValueError(
+                        f"unknown remat_policy '{c.remat_policy}'")
+                block = jax.checkpoint(body, prevent_cse=False,
+                                       policy=policy)
             (x, aux), _ = jax.lax.scan(
                 block, (x, jnp.zeros((), jnp.float32)), stacked)
         aux = aux / c.n_layers  # mean load-balancing loss over layers

@@ -216,9 +216,17 @@ def initialize_runtime(cfg: Config) -> Runtime:
     ``cfg.train.device`` ("auto" prefers TPU, parity with reference
     device="auto" → cuda-if-available, src/distributed_trainer.py:53-58),
     resolve the mesh shape, and construct the mesh."""
+    device_pref = cfg.train.device
+    if device_pref == "cpu":
+        # Hard-select the CPU platform BEFORE anything (including
+        # jax.distributed auto-detection below) can initialize a
+        # backend: probing an accelerator plugin can block or fail when
+        # the TPU runtime is present but unhealthy, and `device=cpu`
+        # (the reference's CPU/Gloo fallback, src/distributed_trainer
+        # .py:55-61) must never depend on accelerator health.
+        jax.config.update("jax_platforms", "cpu")
     _maybe_init_distributed()
 
-    device_pref = cfg.train.device
     if device_pref in ("auto", ""):
         devices = jax.devices()
     else:

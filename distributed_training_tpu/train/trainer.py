@@ -33,13 +33,76 @@ logger = logging.getLogger(__name__)
 
 
 def make_train_step(model, optimizer: optax.GradientTransformation,
-                    nan_guard: bool = False):
+                    nan_guard: bool = False, grad_accum_steps: int = 1,
+                    microbatch_sharding=None):
     """Build the pure train-step function (pre-jit).
 
     The entire reference ``_run_batch`` (zero_grad → forward → loss →
     backward → step, src/distributed_trainer.py:160-165) plus the
     collective layer beneath it, as one traced function.
+
+    ``grad_accum_steps > 1`` splits the global batch into that many
+    microbatches and accumulates mean gradients over a ``lax.scan`` —
+    one optimizer step per call either way, so larger effective batches
+    fit in HBM at the same peak activation memory. Requires the global
+    batch to split evenly (checked at trace time via the reshape).
     """
+
+    def accumulated_grads(params, batch, rng):
+        def loss_fn(p, b, r):
+            loss, metrics = model.loss(p, b, r, train=True)
+            return loss, metrics
+
+        if grad_accum_steps <= 1:
+            return jax.value_and_grad(loss_fn, has_aux=True)(
+                params, batch, rng)
+        a = grad_accum_steps
+        # STRIDED split (microbatch i = rows i, i+a, i+2a, ...), not
+        # contiguous chunks: each device's contiguous batch shard
+        # contains an equal residue of every stride class, so every
+        # microbatch row stays on its original device — a contiguous
+        # split would force an all-to-all of the whole batch each step.
+        # Mean-of-means is identical over any equal partition.
+        micro = jax.tree.map(
+            lambda x: jnp.swapaxes(
+                x.reshape((x.shape[0] // a, a) + x.shape[1:]), 0, 1),
+            dict(batch))
+        if microbatch_sharding is not None:
+            # Keep the (now second) batch dim sharded over the data
+            # axes — without the constraint XLA may shard the scan dim.
+            micro = jax.tree.map(
+                lambda x: jax.lax.with_sharding_constraint(
+                    x, microbatch_sharding), micro)
+
+        def body(carry, inp):
+            acc_grads, acc_loss, acc_metrics = carry
+            i, mb = inp
+            (loss, metrics), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, mb,
+                                       jax.random.fold_in(rng, i))
+            acc_grads = jax.tree.map(jnp.add, acc_grads, grads)
+            acc_metrics = jax.tree.map(jnp.add, acc_metrics, metrics)
+            return (acc_grads, acc_loss + loss, acc_metrics), None
+
+        zero_g = jax.tree.map(jnp.zeros_like, params)
+        mb0 = jax.tree.map(lambda x: x[0], micro)
+        _, zero_m = jax.eval_shape(
+            lambda: loss_fn(params, mb0, rng))
+        zero_m = jax.tree.map(
+            lambda s: jnp.zeros(s.shape, s.dtype), zero_m)
+        (grads, loss, metrics), _ = jax.lax.scan(
+            body, (zero_g, jnp.zeros((), jnp.float32), zero_m),
+            (jnp.arange(a), micro))
+        inv = 1.0 / a
+        mean_loss = loss * inv
+        metrics = jax.tree.map(lambda m: m * inv, dict(metrics))
+        # Nonlinear derived metrics don't average arithmetically
+        # (Jensen): recompute from the averaged loss so accum=N logs
+        # the same value as accum=1 at the same effective batch.
+        if "perplexity" in metrics:
+            metrics["perplexity"] = jnp.exp(mean_loss)
+        return (mean_loss, metrics), jax.tree.map(
+            lambda g: g * inv, grads)
 
     def train_step(state: dict, batch: Mapping[str, jax.Array],
                    base_rng: jax.Array):
@@ -47,12 +110,7 @@ def make_train_step(model, optimizer: optax.GradientTransformation,
                                    state["step"])
         rng = jax.random.fold_in(base_rng, step)
 
-        def loss_fn(p):
-            loss, metrics = model.loss(p, batch, rng, train=True)
-            return loss, metrics
-
-        (loss, metrics), grads = jax.value_and_grad(
-            loss_fn, has_aux=True)(params)
+        (loss, metrics), grads = accumulated_grads(params, batch, rng)
         updates, new_opt = optimizer.update(grads, opt_state, params)
         new_params = optax.apply_updates(params, updates)
 
@@ -119,8 +177,12 @@ class Trainer:
                                             self.strategy.batch_spec())
 
         self._step_fn = jax.jit(
-            make_train_step(model, self.optimizer,
-                            nan_guard=tcfg.nan_guard),
+            make_train_step(
+                model, self.optimizer, nan_guard=tcfg.nan_guard,
+                grad_accum_steps=tcfg.grad_accum_steps,
+                microbatch_sharding=NamedSharding(
+                    runtime.mesh,
+                    P(None, *self.strategy.batch_spec()))),
             donate_argnums=(0,),
             out_shardings=(self.state_shardings,
                            NamedSharding(runtime.mesh, P())),
