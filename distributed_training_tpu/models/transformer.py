@@ -355,13 +355,11 @@ class Transformer:
         else:
             block = body
             if c.remat:
-                policy = None
-                if c.remat_policy == "selective":
-                    policy = jax.checkpoint_policies.\
-                        save_only_these_names("attn_out")
-                elif c.remat_policy != "full":
-                    raise ValueError(
-                        f"unknown remat_policy '{c.remat_policy}'")
+                # Values validated in __post_init__; "full" → default
+                # save-nothing policy.
+                policy = (jax.checkpoint_policies.save_only_these_names(
+                    "attn_out") if c.remat_policy == "selective"
+                    else None)
                 block = jax.checkpoint(body, prevent_cse=False,
                                        policy=policy)
             (x, aux), _ = jax.lax.scan(

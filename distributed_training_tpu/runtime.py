@@ -211,11 +211,19 @@ def _maybe_init_distributed() -> None:
             raise
 
 
+# Sentinel + saved value for the device=cpu platform force (see
+# initialize_runtime): lets a later auto/tpu call in the same process
+# restore the original platform selection.
+_UNFORCED = object()
+_PLATFORMS_BEFORE_CPU_FORCE: object = _UNFORCED
+
+
 def initialize_runtime(cfg: Config) -> Runtime:
     """Build the runtime: rendezvous (if multi-host), pick devices per
     ``cfg.train.device`` ("auto" prefers TPU, parity with reference
     device="auto" → cuda-if-available, src/distributed_trainer.py:53-58),
     resolve the mesh shape, and construct the mesh."""
+    global _PLATFORMS_BEFORE_CPU_FORCE
     device_pref = cfg.train.device
     if device_pref == "cpu":
         # Hard-select the CPU platform BEFORE anything (including
@@ -224,7 +232,16 @@ def initialize_runtime(cfg: Config) -> Runtime:
         # the TPU runtime is present but unhealthy, and `device=cpu`
         # (the reference's CPU/Gloo fallback, src/distributed_trainer
         # .py:55-61) must never depend on accelerator health.
+        if _PLATFORMS_BEFORE_CPU_FORCE is _UNFORCED:
+            _PLATFORMS_BEFORE_CPU_FORCE = jax.config.jax_platforms
         jax.config.update("jax_platforms", "cpu")
+    elif _PLATFORMS_BEFORE_CPU_FORCE is not _UNFORCED:
+        # A previous device=cpu call forced the platform; undo it so
+        # "auto"/"tpu" in the same process sees accelerators again
+        # (best effort — backends a prior run already initialized on a
+        # forced-cpu platform set may persist in jax's cache).
+        jax.config.update("jax_platforms", _PLATFORMS_BEFORE_CPU_FORCE)
+        _PLATFORMS_BEFORE_CPU_FORCE = _UNFORCED
     _maybe_init_distributed()
 
     if device_pref in ("auto", ""):

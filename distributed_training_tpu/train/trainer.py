@@ -151,6 +151,15 @@ class Trainer:
         # utils/preemption.py. None → never stops early.
         self.preemption_guard = preemption_guard
         tcfg = cfg.train
+        if tcfg.grad_accum_steps > 1 and \
+                loader.batch_size % tcfg.grad_accum_steps:
+            # The strided microbatch split is zero-communication only
+            # when each shard's rows divide evenly into the stride
+            # classes; otherwise GSPMD would silently reshard the whole
+            # batch every step. Fail loudly instead.
+            raise ValueError(
+                f"grad_accum_steps={tcfg.grad_accum_steps} must divide "
+                f"the per-shard batch_size={loader.batch_size}")
 
         from distributed_training_tpu.parallel import get_strategy
         self.strategy: ShardingStrategy = get_strategy(

@@ -1,0 +1,56 @@
+"""Benchmark harness (benchmarks/run.py): the machinery must run
+end-to-end and emit the schema the baseline record needs. Heavy configs
+are TPU-targeted; the CPU-runnable one exercises the whole path."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "benchmarks"))
+
+import run as bench_run  # noqa: E402
+
+
+def test_config_inventory_matches_baseline():
+    """One harness config per BASELINE.json entry."""
+    with open(os.path.join(REPO, "BASELINE.json")) as f:
+        n_baseline = len(json.load(f)["configs"])
+    assert len(bench_run.CONFIGS) == n_baseline == 5
+
+
+def test_mlp_cpu_end_to_end():
+    res = bench_run.run_config("mlp_cpu", steps=4, warmup=1,
+                               full_size=False)
+    assert res["config"] == "mlp_cpu"
+    assert res["num_devices"] >= 1
+    assert res["step_time_ms"] > 0
+    assert res["samples_per_sec_per_chip"] > 0
+    assert len(res["loss_curve"]) == 4
+    assert all(l > 0 for l in res["loss_curve"])
+    assert "mfu" in res
+
+
+def test_cli_writes_out_file(tmp_path):
+    out = tmp_path / "res.json"
+    rc = bench_run.main(["--config", "mlp_cpu", "--steps", "2",
+                         "--warmup", "1", "--out", str(out)])
+    assert rc == 0
+    data = json.loads(out.read_text())
+    assert data["config"] == "mlp_cpu"
+
+
+@pytest.mark.parametrize("name", sorted(bench_run.CONFIGS))
+def test_models_construct(name):
+    """Every benchmark config's model builds (scaled size) — catches
+    registry/kwargs drift without training."""
+    from distributed_training_tpu.models import build_model
+    spec = bench_run.CONFIGS[name]
+    model_name, kwargs = spec["model"]
+    kwargs = dict(kwargs)
+    kwargs.update(spec.get("scaled_kwargs", {}))
+    model = build_model(model_name, dtype="float32", **kwargs)
+    assert model is not None

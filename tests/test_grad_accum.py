@@ -54,8 +54,10 @@ def test_grad_accum_matches_single_pass(cpu8):
 
 
 def test_grad_accum_uneven_split_fails_loudly(cpu8):
-    with pytest.raises(Exception):
-        run_losses(cpu8, accum=7, steps=1)  # 64 % 7 != 0
+    # per-shard batch is 8; 7 doesn't divide it → Trainer rejects it
+    # up front (a silent GSPMD reshard would otherwise eat the perf).
+    with pytest.raises(ValueError, match="grad_accum_steps"):
+        run_losses(cpu8, accum=7, steps=1)
 
 
 def tiny_tf(remat, policy="selective"):
