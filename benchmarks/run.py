@@ -65,7 +65,7 @@ CONFIGS: dict = {
         "model": ("resnet18", {"num_classes": 10}),
         "overrides": _base({
             "train.batch_size": 64,
-            "train.dataset": "synthetic_image",
+            "train.dataset": "synthetic_images",
             "train.dataset_kwargs": {"size": 2048},
             "train.optimizer": "adamw",
             "train.learning_rate": 1e-3,
@@ -144,16 +144,19 @@ def run_config(name: str, steps: int, warmup: int,
     from distributed_training_tpu.train.trainer import Trainer
     from distributed_training_tpu.utils.metrics import peak_flops_per_chip
 
+    if steps < 1:
+        raise ValueError(f"steps must be >= 1, got {steps}")
+    if warmup < 0:
+        raise ValueError(f"warmup must be >= 0, got {warmup}")
     spec = CONFIGS[name]
-    cfg = Config()
+    from distributed_training_tpu.config import override_config
+    groups: dict = {}
+    for path, val in spec["overrides"].items():
+        group, leaf = path.split(".", 1)
+        groups.setdefault(group, {})[leaf] = val
+    cfg = override_config(Config(), **groups)
     if spec.get("device"):
         cfg.train.device = spec["device"]
-    for path, val in spec["overrides"].items():
-        obj = cfg
-        *parents, leaf = path.split(".")
-        for part in parents:
-            obj = getattr(obj, part)
-        setattr(obj, leaf, val)
 
     rt = initialize_runtime(cfg)
     model_name, model_kwargs = spec["model"]
@@ -179,7 +182,8 @@ def run_config(name: str, steps: int, warmup: int,
     losses = []
     for i in range(warmup):
         m = trainer.train_step(batches[i % len(batches)])
-    jax.block_until_ready(m["loss"])
+    if warmup:
+        jax.block_until_ready(m["loss"])
 
     t0 = time.perf_counter()
     for i in range(steps):

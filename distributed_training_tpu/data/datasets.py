@@ -124,9 +124,11 @@ class MemmapTokenDataset:
     standard 'tokenized shard on shared storage' layout for real LM
     pretraining. Rows are non-overlapping windows of ``seq_len + 1``."""
 
-    def __init__(self, path: str, seq_len: int, dtype: str = "uint16"):
+    def __init__(self, path: str, seq_len: int, dtype: str = "uint16",
+                 vocab_size: int = 50257):
         self._data = np.memmap(path, dtype=dtype, mode="r")
         self.seq_len = seq_len
+        self.vocab_size = vocab_size
         self._size = (len(self._data) - 1) // seq_len
         if self._size <= 0:
             raise ValueError(f"{path} too small for seq_len={seq_len}")
@@ -159,6 +161,11 @@ def build_dataset(name: str, _defaults: dict | None = None,
         "synthetic_lm": SyntheticLMDataset,
         "synthetic_images": SyntheticImageDataset,
         "memmap_tokens": MemmapTokenDataset,
+        # Byte-level LM over ANY local file: the zero-dependency real-
+        # data path (subword tokenizers need downloaded vocab files;
+        # bytes need nothing). vocab_size 256, uint8 storage.
+        "bytes": lambda path, seq_len: MemmapTokenDataset(
+            path, seq_len, dtype="uint8", vocab_size=256),
     }
     if name not in builders:
         raise ValueError(

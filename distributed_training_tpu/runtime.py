@@ -224,6 +224,13 @@ def initialize_runtime(cfg: Config) -> Runtime:
     device="auto" → cuda-if-available, src/distributed_trainer.py:53-58),
     resolve the mesh shape, and construct the mesh."""
     global _PLATFORMS_BEFORE_CPU_FORCE
+    # An explicit JAX_PLATFORMS env var wins over site customizations
+    # that pin jax_platforms at interpreter start (some managed images
+    # pin their accelerator plugin, which would silently override the
+    # documented env-var contract).
+    env_platforms = os.environ.get("JAX_PLATFORMS")
+    if env_platforms and jax.config.jax_platforms != env_platforms:
+        jax.config.update("jax_platforms", env_platforms)
     device_pref = cfg.train.device
     if device_pref == "cpu":
         # Hard-select the CPU platform BEFORE anything (including
@@ -239,8 +246,12 @@ def initialize_runtime(cfg: Config) -> Runtime:
         # A previous device=cpu call forced the platform; undo it so
         # "auto"/"tpu" in the same process sees accelerators again
         # (best effort — backends a prior run already initialized on a
-        # forced-cpu platform set may persist in jax's cache).
-        jax.config.update("jax_platforms", _PLATFORMS_BEFORE_CPU_FORCE)
+        # forced-cpu platform set may persist in jax's cache). An
+        # explicit JAX_PLATFORMS env var still wins: never overwrite
+        # the value the block above just applied.
+        if not env_platforms:
+            jax.config.update("jax_platforms",
+                              _PLATFORMS_BEFORE_CPU_FORCE)
         _PLATFORMS_BEFORE_CPU_FORCE = _UNFORCED
     _maybe_init_distributed()
 

@@ -1,0 +1,122 @@
+"""HBM budget estimation for training configs.
+
+Answers "will this config fit on this chip / how many chips do I need?"
+before burning a compile: params + grads + optimizer state are exact
+from shapes; activations use the standard transformer accounting
+(per-layer residuals and block internals, scaled by the remat policy).
+The reference has nothing comparable — its models are Linear(20,1) —
+but the BASELINE.json 1B/7B FSDP configs live or die on this arithmetic.
+
+Estimates are per chip: pass ``fsdp`` (and ``tp``) shard counts to see
+the sharded footprint.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import numpy as np
+
+_BYTES = {"float32": 4, "bfloat16": 2, "float16": 2}
+
+# Known per-chip HBM capacities (GiB) for planning output.
+HBM_GIB = {
+    "v4": 32.0,
+    "v5e": 16.0,
+    "v5 lite": 16.0,
+    "v5p": 95.0,
+    "v6e": 32.0,
+}
+
+
+def param_count(params) -> int:
+    return sum(int(np.prod(x.shape)) for x in jax.tree.leaves(params))
+
+
+@dataclass
+class MemoryEstimate:
+    params_gib: float
+    grads_gib: float
+    opt_gib: float
+    activations_gib: float
+
+    @property
+    def total_gib(self) -> float:
+        return (self.params_gib + self.grads_gib + self.opt_gib
+                + self.activations_gib)
+
+    def fits(self, device_kind: str, headroom: float = 0.85) -> bool:
+        """Whether the estimate fits in ``device_kind``'s HBM, leaving
+        ``1 - headroom`` for XLA scratch/fragmentation."""
+        cap = HBM_GIB.get(device_kind.lower())
+        if cap is None:
+            raise ValueError(f"unknown device kind '{device_kind}'; "
+                             f"known: {sorted(HBM_GIB)}")
+        return self.total_gib <= cap * headroom
+
+
+def estimate_transformer_memory(
+        tf_cfg, batch_per_chip: int, seq_len: int,
+        optimizer: str = "adamw", fsdp: int = 1, tp: int = 1,
+) -> MemoryEstimate:
+    """Per-chip training footprint of a ``TransformerConfig``.
+
+    - params/grads: n_params × dtype bytes, sharded over fsdp×tp;
+    - optimizer: AdamW = two fp32 moments (+ fp32 master view is not
+      kept — params are the master copy), SGD = none;
+    - activations (per layer, batch B, seq S, width D, ffn F):
+        no remat:      residual + ln + qkv + attn-out + mlp-in + gelu
+                       ≈ (6·D + 2·F) · B·S · bytes
+        remat full:    only the inter-layer residual survives the scan
+                       ≈ 2·D · B·S · bytes (carry + saved input)
+        remat selective: residual + saved attention output
+                       ≈ 3·D · B·S · bytes
+      plus the logits buffer B·S·V fp32 (often the true peak).
+    These are planning numbers, not allocator ground truth — XLA
+    fusion/padding moves them ±20%.
+    """
+    c = tf_cfg
+    pb = _BYTES[c.param_dtype]
+    ab = _BYTES[c.dtype]
+    d_ff = c.d_ff or 4 * c.d_model
+
+    embed = c.vocab_size * c.d_model
+    if getattr(c, "pos_encoding", "learned") == "learned":
+        embed += c.max_seq_len * c.d_model
+    n_kv = getattr(c, "n_kv_heads", 0) or c.n_heads
+    kv_dim = c.d_model * n_kv // c.n_heads        # GQA: smaller k/v
+    per_layer = (2 * c.d_model * c.d_model        # attn q, o
+                 + 2 * c.d_model * kv_dim         # attn k, v
+                 + 2 * c.d_model * d_ff           # mlp in/out
+                 + d_ff + 3 * c.d_model           # biases
+                 + 4 * c.d_model)                 # ln scales/biases
+    if getattr(c, "moe_num_experts", 0):
+        per_layer += (c.moe_num_experts - 1) * 2 * c.d_model * d_ff
+    n_params = embed + c.n_layers * per_layer + 2 * c.d_model
+    if not getattr(c, "tie_embeddings", True):
+        n_params += c.vocab_size * c.d_model
+
+    model_shards = max(1, fsdp) * max(1, tp)
+    params_b = n_params * pb / model_shards
+    grads_b = n_params * pb / model_shards
+    opt_b = (2 * n_params * 4 / model_shards
+             if optimizer == "adamw" else 0.0)
+
+    B, S, D, F = batch_per_chip, seq_len, c.d_model, d_ff
+    if not c.remat:
+        act_per_layer = (6 * D + 2 * F) * B * S * ab
+    elif c.remat_policy == "selective":
+        act_per_layer = 3 * D * B * S * ab
+    else:  # full
+        act_per_layer = 2 * D * B * S * ab
+    acts_b = c.n_layers * act_per_layer
+    acts_b += B * S * c.vocab_size * 4 / max(1, tp)  # fp32 logits
+
+    gib = 1 / (1024 ** 3)
+    return MemoryEstimate(
+        params_gib=params_b * gib,
+        grads_gib=grads_b * gib,
+        opt_gib=opt_b * gib,
+        activations_gib=acts_b * gib,
+    )
