@@ -1,0 +1,122 @@
+#!/usr/bin/env python
+"""Decompose train-step time to find the MFU bottleneck.
+
+Times, separately jitted on the same params/batch:
+  fwd        model.apply only
+  loss       loss (adds fp32 logits + softmax xent)
+  grad       value_and_grad (fwd + bwd)
+  step       full train step (adds optimizer update)
+and optionally writes a jax.profiler trace for XProf.
+
+    python benchmarks/profile_step.py --model gpt2_125m --batch 8
+    python benchmarks/profile_step.py --trace /tmp/trace
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def timed(fn, *args, iters=10):
+    import jax
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--model", default="gpt2_125m")
+    p.add_argument("--batch", type=int, default=8)
+    p.add_argument("--seq-len", type=int, default=1024)
+    p.add_argument("--dtype", default="bfloat16")
+    p.add_argument("--attention", default="auto")
+    p.add_argument("--remat", action="store_true")
+    p.add_argument("--iters", type=int, default=10)
+    p.add_argument("--trace", default=None,
+                   help="write a jax.profiler trace to this dir")
+    p.add_argument("--model-kwargs", default="{}",
+                   help="JSON kwargs forwarded to build_model "
+                        "(e.g. '{\"n_layers\": 2}' for smoke runs)")
+    p.add_argument("--vocab-size", type=int, default=50257)
+    args = p.parse_args(argv)
+    import json as _json
+    model_kwargs = _json.loads(args.model_kwargs)
+
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_training_tpu.config import Config
+    from distributed_training_tpu.data import (ShardedDataLoader,
+                                               SyntheticLMDataset)
+    from distributed_training_tpu.models import build_model
+    from distributed_training_tpu.runtime import initialize_runtime
+    from distributed_training_tpu.train.trainer import Trainer
+    from distributed_training_tpu.utils.metrics import peak_flops_per_chip
+
+    cfg = Config()
+    cfg.train.batch_size = args.batch
+    cfg.train.optimizer = "adamw"
+    cfg.train.dtype = args.dtype
+    cfg.train.log_every = 0
+    rt = initialize_runtime(cfg)
+    model = build_model(args.model, dtype=args.dtype,
+                        attention_impl=args.attention, remat=args.remat,
+                        **model_kwargs)
+    ds = SyntheticLMDataset(size=max(64, args.batch),
+                            seq_len=args.seq_len,
+                            vocab_size=args.vocab_size, seed=0)
+    loader = ShardedDataLoader(ds, rt, batch_size=args.batch,
+                               shuffle=False)
+    trainer = Trainer(cfg, rt, model, loader)
+    batch = next(iter(loader.epoch(0)))
+    params = trainer.state["params"]
+    rng = jax.random.PRNGKey(0)
+    inputs = batch["tokens"][:, :-1]
+
+    fwd = jax.jit(lambda p, t: model.apply(p, t)[0])
+    loss = jax.jit(lambda p, b: model.loss(p, b, rng)[0])
+    grad = jax.jit(jax.grad(lambda p, b: model.loss(p, b, rng)[0]))
+
+    times = {
+        "fwd_ms": timed(fwd, params, inputs, iters=args.iters) * 1e3,
+        "loss_ms": timed(loss, params, batch, iters=args.iters) * 1e3,
+        "grad_ms": timed(grad, params, batch, iters=args.iters) * 1e3,
+        "step_ms": timed(trainer.train_step, batch,
+                         iters=args.iters) * 1e3,
+    }
+    times["bwd_ms"] = times["grad_ms"] - times["loss_ms"]
+    times["xent_ms"] = times["loss_ms"] - times["fwd_ms"]
+    times["opt_ms"] = times["step_ms"] - times["grad_ms"]
+
+    toks = loader.global_batch * args.seq_len
+    flops = model.flops_per_token(args.seq_len) * toks
+    peak = peak_flops_per_chip(rt.device_kind)
+    for name in ("fwd_ms", "loss_ms", "grad_ms", "step_ms", "bwd_ms",
+                 "xent_ms", "opt_ms"):
+        print(f"{name:>8}: {times[name]:8.2f}")
+    print(f"step mfu: {flops / (times['step_ms'] / 1e3) / peak / rt.num_devices:.4f}")
+    print(f"ideal dense-only step (6ND/peak): "
+          f"{flops / peak * 1e3:.1f} ms")
+
+    if args.trace:
+        with jax.profiler.trace(args.trace):
+            for _ in range(3):
+                trainer.train_step(batch)
+            jax.block_until_ready(trainer.state["params"])
+        print(f"trace written to {args.trace}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

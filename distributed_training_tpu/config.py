@@ -49,7 +49,7 @@ class TrainConfig:
     device: str = "auto"          # "auto" | "tpu" | "cpu"
     parallel_strategy: str = "ddp"  # "ddp" | "fsdp" (+ framework extensions)
     seed: int = 42
-    optimizer: str = "sgd"        # "sgd" | "adamw"
+    optimizer: str = "sgd"        # "sgd" | "adamw" | "adafactor"
     weight_decay: float = 0.0
     b1: float = 0.9
     b2: float = 0.95

@@ -25,6 +25,7 @@ framework's own Model protocol.
 from __future__ import annotations
 
 import dataclasses
+import functools
 import math
 from dataclasses import dataclass
 
@@ -76,6 +77,9 @@ class TransformerConfig:
             raise ValueError("d_model must divide into n_heads")
         if self.n_heads % self.n_kv_heads:
             raise ValueError("n_heads must divide into n_kv_heads")
+        if not 0.0 <= self.dropout < 1.0:
+            raise ValueError(
+                f"dropout must be in [0, 1), got {self.dropout}")
         if self.remat_policy not in ("full", "selective"):
             # Validate here (not only in the remat branch of apply) so
             # a typo surfaces at construction even with remat=False or
@@ -106,6 +110,14 @@ PRESETS: dict[str, dict] = {
                            pos_encoding="rope", tie_embeddings=False,
                            remat=True),
 }
+
+
+def _dropout(x: jax.Array, rng: jax.Array, rate: float) -> jax.Array:
+    """Inverted dropout: zero with prob ``rate``, scale kept values by
+    1/(1-rate) so the expectation is unchanged."""
+    keep = jax.random.bernoulli(rng, 1.0 - rate, x.shape)
+    return jnp.where(keep, x / (1.0 - rate),
+                     jnp.zeros((), x.dtype)).astype(x.dtype)
 
 
 def _rope(q: jax.Array, k: jax.Array, positions: jax.Array) -> tuple:
@@ -260,12 +272,17 @@ class Transformer:
 
     # -- forward -----------------------------------------------------------
 
-    def _block(self, x: jax.Array, layer: dict, positions: jax.Array
+    def _block(self, x: jax.Array, layer: dict, positions: jax.Array,
+               dropout_rng: jax.Array | None = None
                ) -> tuple[jax.Array, jax.Array]:
         """One decoder block. x: (B, S, D) in compute dtype.
-        Returns (x, aux_loss)."""
+        Returns (x, aux_loss). ``dropout_rng`` non-None enables
+        residual-branch dropout at ``cfg.dropout`` (GPT-2's
+        resid_pdrop)."""
         c = self.cfg
         dt = x.dtype
+        drop = (functools.partial(_dropout, rate=c.dropout)
+                if dropout_rng is not None else None)
 
         h = _layer_norm(x, layer["ln1"]["scale"], layer["ln1"]["bias"])
         q = jnp.einsum("bsd,dhk->bshk", h, layer["attn"]["wq"].astype(dt))
@@ -277,8 +294,12 @@ class Transformer:
         # Named so the "selective" remat policy can pin it as saved
         # while everything else in the block rematerializes.
         attn = jax.ad_checkpoint.checkpoint_name(attn, "attn_out")
-        x = x + jnp.einsum("bshk,hkd->bsd", attn,
-                           layer["attn"]["wo"].astype(dt))
+        attn_proj = jnp.einsum("bshk,hkd->bsd", attn,
+                               layer["attn"]["wo"].astype(dt))
+        if drop is not None:
+            attn_proj = drop(attn_proj,
+                             rng=jax.random.fold_in(dropout_rng, 0))
+        x = x + attn_proj
 
         h = _layer_norm(x, layer["ln2"]["scale"], layer["ln2"]["bias"])
         if c.moe_num_experts > 0:
@@ -291,18 +312,29 @@ class Transformer:
             mlp_out = jnp.einsum("bsf,fd->bsd", u, m["wo"].astype(dt)) \
                 + m["bo"].astype(dt)
             aux = jnp.zeros((), jnp.float32)
+        if drop is not None:
+            mlp_out = drop(mlp_out,
+                           rng=jax.random.fold_in(dropout_rng, 1))
         return x + mlp_out, aux
 
-    def apply(self, params, tokens: jax.Array) -> tuple[jax.Array,
-                                                        jax.Array]:
-        """tokens (B, S) int32 → logits (B, S, V) fp32, aux loss scalar."""
+    def apply(self, params, tokens: jax.Array,
+              rng: jax.Array | None = None, train: bool = False
+              ) -> tuple[jax.Array, jax.Array]:
+        """tokens (B, S) int32 → logits (B, S, V) fp32, aux loss scalar.
+
+        Dropout (``cfg.dropout > 0``) is active only when ``train`` and
+        an ``rng`` is given; eval/inference is deterministic."""
         c = self.cfg
         dt = jnp.dtype(c.dtype)
         B, S = tokens.shape
+        dropping = bool(train and c.dropout > 0.0 and rng is not None)
         x = params["tok_embed"][tokens].astype(dt)
         positions = jnp.arange(S)
         if c.pos_encoding == "learned":
             x = x + params["pos_embed"][:S].astype(dt)
+        if dropping:  # GPT-2's embd_pdrop (fold_in needs non-negative)
+            x = _dropout(x, rng=jax.random.fold_in(rng, 1_000_003),
+                         rate=c.dropout)
 
         # Stack per-layer params for the scan: they already carry a
         # leading L dim.
@@ -313,12 +345,29 @@ class Transformer:
             pp = dict(zip(self.mesh.axis_names,
                           self.mesh.devices.shape)).get("pp", 1)
 
-        def body(carry, layer):
-            x, aux = carry
-            x, layer_aux = self._block(x, layer, positions)
-            return (x, aux + layer_aux), None
+        if dropping:
+            layer_rngs = jax.random.split(
+                jax.random.fold_in(rng, 7), c.n_layers)
+
+            def body(carry, inp):
+                layer, layer_rng = inp
+                x, aux = carry
+                x, layer_aux = self._block(x, layer, positions,
+                                           dropout_rng=layer_rng)
+                return (x, aux + layer_aux), None
+            scan_xs = (stacked, layer_rngs)
+        else:
+            def body(carry, layer):
+                x, aux = carry
+                x, layer_aux = self._block(x, layer, positions)
+                return (x, aux + layer_aux), None
+            scan_xs = stacked
 
         if pp > 1:
+            if dropping:
+                raise NotImplementedError(
+                    "dropout under pipeline parallelism (pp>1) is not "
+                    "wired yet; set dropout=0 or pp=1")
             # GPipe wavefront over pp stages (parallel/pipeline.py):
             # each stage scans its local layer shard per microbatch.
             if c.attention_impl == "ring":
@@ -363,7 +412,7 @@ class Transformer:
                 block = jax.checkpoint(body, prevent_cse=False,
                                        policy=policy)
             (x, aux), _ = jax.lax.scan(
-                block, (x, jnp.zeros((), jnp.float32)), stacked)
+                block, (x, jnp.zeros((), jnp.float32)), scan_xs)
         aux = aux / c.n_layers  # mean load-balancing loss over layers
 
         x = _layer_norm(x, params["final_norm"]["scale"],
@@ -376,10 +425,9 @@ class Transformer:
     # -- loss --------------------------------------------------------------
 
     def loss(self, params, batch, rng: jax.Array, train: bool = True):
-        del rng, train
         tokens = batch["tokens"]
         inputs, targets = tokens[:, :-1], tokens[:, 1:]
-        logits, aux = self.apply(params, inputs)
+        logits, aux = self.apply(params, inputs, rng=rng, train=train)
         logp = jax.nn.log_softmax(logits, axis=-1)
         nll = -jnp.take_along_axis(logp, targets[..., None],
                                    axis=-1)[..., 0]

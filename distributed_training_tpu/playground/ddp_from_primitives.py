@@ -240,12 +240,8 @@ def main(argv=None) -> int:
     args = p.parse_args(argv)
     logging.basicConfig(level=logging.INFO,
                         format="%(asctime)s %(message)s")
-    # Honor an explicit JAX_PLATFORMS env var even when a site
-    # customization pinned jax_platforms at interpreter start (same
-    # contract as runtime.initialize_runtime).
-    env_platforms = os.environ.get("JAX_PLATFORMS")
-    if env_platforms and jax.config.jax_platforms != env_platforms:
-        jax.config.update("jax_platforms", env_platforms)
+    from distributed_training_tpu.runtime import apply_env_platforms
+    apply_env_platforms()
     result = train_ddp(
         world_size=args.world_size, epochs=args.epochs,
         batch_size=args.batch_size, lr=args.lr,

@@ -218,19 +218,25 @@ _UNFORCED = object()
 _PLATFORMS_BEFORE_CPU_FORCE: object = _UNFORCED
 
 
+def apply_env_platforms() -> str | None:
+    """Make an explicit ``JAX_PLATFORMS`` env var win over site
+    customizations that pin ``jax_platforms`` at interpreter start
+    (some managed images pin their accelerator plugin, which would
+    silently override the documented env-var contract). Returns the
+    env value, or None if unset. Shared by every entrypoint."""
+    env_platforms = os.environ.get("JAX_PLATFORMS")
+    if env_platforms and jax.config.jax_platforms != env_platforms:
+        jax.config.update("jax_platforms", env_platforms)
+    return env_platforms or None
+
+
 def initialize_runtime(cfg: Config) -> Runtime:
     """Build the runtime: rendezvous (if multi-host), pick devices per
     ``cfg.train.device`` ("auto" prefers TPU, parity with reference
     device="auto" → cuda-if-available, src/distributed_trainer.py:53-58),
     resolve the mesh shape, and construct the mesh."""
     global _PLATFORMS_BEFORE_CPU_FORCE
-    # An explicit JAX_PLATFORMS env var wins over site customizations
-    # that pin jax_platforms at interpreter start (some managed images
-    # pin their accelerator plugin, which would silently override the
-    # documented env-var contract).
-    env_platforms = os.environ.get("JAX_PLATFORMS")
-    if env_platforms and jax.config.jax_platforms != env_platforms:
-        jax.config.update("jax_platforms", env_platforms)
+    env_platforms = apply_env_platforms()
     device_pref = cfg.train.device
     if device_pref == "cpu":
         # Hard-select the CPU platform BEFORE anything (including

@@ -40,6 +40,13 @@ def build_optimizer(cfg: TrainConfig,
     elif cfg.optimizer == "adamw":
         core = optax.adamw(sched, b1=cfg.b1, b2=cfg.b2,
                            weight_decay=cfg.weight_decay)
+    elif cfg.optimizer == "adafactor":
+        # TPU-idiomatic memory-lean choice for the largest FSDP
+        # configs: factored second moment ≈ (rows+cols) state per
+        # matrix instead of Adam's 2x full-size fp32 moments.
+        core = optax.adafactor(sched,
+                               weight_decay_rate=(cfg.weight_decay
+                                                  or None))
     else:
         raise ValueError(f"unknown optimizer '{cfg.optimizer}'")
     parts = []

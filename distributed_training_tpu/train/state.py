@@ -12,6 +12,7 @@ from typing import Any
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -29,9 +30,22 @@ def state_specs(strategy: ShardingStrategy,
     """
     param_specs = strategy.specs_for_tree(param_shapes, logical_axes)
     opt_shapes = jax.eval_shape(optimizer.init, param_shapes)
+
+    def spec_for_opt_leaf(leaf, spec):
+        # Optimizer state that is not param-shaped cannot inherit the
+        # param's spec: Adafactor's factored v_row/v_col are lower
+        # rank, and its shape-(1,) placeholders (for non-factored
+        # params) are rank-1 but size-1 — partitioning either is
+        # nonsense. Replicate both; they are tiny by construction.
+        if isinstance(spec, P) and hasattr(leaf, "ndim"):
+            size = int(np.prod(leaf.shape)) if leaf.ndim else 1
+            if len(spec) > leaf.ndim or size <= 1:
+                return P()
+        return spec
+
     opt_specs = optax.tree_map_params(
         optimizer,
-        lambda _leaf, spec: spec,
+        spec_for_opt_leaf,
         opt_shapes,
         param_specs,
         transform_non_params=lambda _leaf: P(),

@@ -81,27 +81,23 @@ def estimate_transformer_memory(
     ab = _BYTES[c.dtype]
     d_ff = c.d_ff or 4 * c.d_model
 
-    embed = c.vocab_size * c.d_model
-    if getattr(c, "pos_encoding", "learned") == "learned":
-        embed += c.max_seq_len * c.d_model
-    n_kv = getattr(c, "n_kv_heads", 0) or c.n_heads
-    kv_dim = c.d_model * n_kv // c.n_heads        # GQA: smaller k/v
-    per_layer = (2 * c.d_model * c.d_model        # attn q, o
-                 + 2 * c.d_model * kv_dim         # attn k, v
-                 + 2 * c.d_model * d_ff           # mlp in/out
-                 + d_ff + 3 * c.d_model           # biases
-                 + 4 * c.d_model)                 # ln scales/biases
-    if getattr(c, "moe_num_experts", 0):
-        per_layer += (c.moe_num_experts - 1) * 2 * c.d_model * d_ff
-    n_params = embed + c.n_layers * per_layer + 2 * c.d_model
-    if not getattr(c, "tie_embeddings", True):
-        n_params += c.vocab_size * c.d_model
+    # Exact by construction: trace init shapes abstractly (no compile,
+    # no allocation) instead of shadow-bookkeeping the model layout.
+    from distributed_training_tpu.models.transformer import Transformer
+    shapes = jax.eval_shape(Transformer(c).init, jax.random.PRNGKey(0))
+    n_params = param_count(shapes)
 
     model_shards = max(1, fsdp) * max(1, tp)
     params_b = n_params * pb / model_shards
     grads_b = n_params * pb / model_shards
-    opt_b = (2 * n_params * 4 / model_shards
-             if optimizer == "adamw" else 0.0)
+    if optimizer == "adamw":
+        opt_b = 2 * n_params * 4 / model_shards
+    elif optimizer == "adafactor":
+        # Factored second moment: rows+cols per matrix ≈ n_params /
+        # min(dim); ~2% of params is a safe planning envelope.
+        opt_b = 0.02 * n_params * 4 / model_shards
+    else:  # sgd (no momentum)
+        opt_b = 0.0
 
     B, S, D, F = batch_per_chip, seq_len, c.d_model, d_ff
     if not c.remat:
