@@ -106,8 +106,8 @@ def main(argv=None) -> int:
                  "xent_ms", "opt_ms"):
         print(f"{name:>8}: {times[name]:8.2f}")
     print(f"step mfu: {flops / (times['step_ms'] / 1e3) / peak / rt.num_devices:.4f}")
-    print(f"ideal dense-only step (6ND/peak): "
-          f"{flops / peak * 1e3:.1f} ms")
+    print(f"ideal dense-only step (6ND/peak/chips): "
+          f"{flops / peak / rt.num_devices * 1e3:.1f} ms")
 
     if args.trace:
         with jax.profiler.trace(args.trace):

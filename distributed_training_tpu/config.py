@@ -72,6 +72,11 @@ class TrainConfig:
     drop_last: bool = False
     max_steps_per_epoch: int = 0  # 0 → whole shard (test/bench aid)
     nan_guard: bool = False       # skip+log non-finite update steps
+    # Held-out evaluation: eval_fraction of the dataset is split off
+    # (deterministically, seed-keyed) and scored every eval_every
+    # epochs with dropout off and no state update. 0 disables either.
+    eval_fraction: float = 0.0
+    eval_every: int = 1           # epochs between evals (if enabled)
     min_shard_elems: int = 4096   # FSDP: replicate arrays smaller than this
     divergence_check_every: int = 0  # steps; 0 disables replica-drift check
     # Steps between cross-host stop-flag polls (multi-host only). Stop

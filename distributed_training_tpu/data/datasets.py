@@ -143,6 +143,54 @@ class MemmapTokenDataset:
         return {"tokens": np.asarray(self._data[window], dtype=np.int32)}
 
 
+class SubsetDataset:
+    """Index-remapped view of a base dataset (no copy)."""
+
+    def __init__(self, base, indices: np.ndarray):
+        self._base = base
+        self._indices = np.asarray(indices, dtype=np.int64)
+        # Surface base attributes models/loaders key off (vocab_size,
+        # seq_len, num_classes, ...).
+        for attr in ("vocab_size", "seq_len", "num_classes"):
+            if hasattr(base, attr):
+                setattr(self, attr, getattr(base, attr))
+
+    def __len__(self) -> int:
+        return len(self._indices)
+
+    def batch(self, indices: np.ndarray) -> Mapping[str, np.ndarray]:
+        return self._base.batch(self._indices[indices])
+
+
+def train_eval_split(ds, eval_fraction: float, seed: int = 0,
+                     multiple_of: int = 1):
+    """Deterministic disjoint (train, eval) split of a map-style
+    dataset. The permutation is seed-keyed and identical on every
+    process (same contract as the sampler's shuffle).
+
+    ``multiple_of``: round the eval size UP to this multiple (callers
+    pass the global batch size). With an exact multiple, the sharded
+    loader never wrap-pads eval batches, so val_loss is an exact mean
+    over the eval rows — padding would double-count duplicated rows
+    and make val_loss depend on the pod's shard count."""
+    if not 0.0 < eval_fraction < 1.0:
+        raise ValueError(
+            f"eval_fraction must be in (0, 1), got {eval_fraction}")
+    if multiple_of < 1:
+        raise ValueError(f"multiple_of must be >= 1, got {multiple_of}")
+    n = len(ds)
+    n_eval = max(1, int(round(n * eval_fraction)))
+    n_eval = -(-n_eval // multiple_of) * multiple_of  # ceil to multiple
+    if n_eval >= n:
+        raise ValueError(
+            f"eval_fraction={eval_fraction} (rounded to a multiple of "
+            f"{multiple_of} -> {n_eval}) leaves no training data "
+            f"(dataset size {n})")
+    perm = np.random.default_rng(seed).permutation(n)
+    return (SubsetDataset(ds, perm[n_eval:]),
+            SubsetDataset(ds, perm[:n_eval]))
+
+
 def build_dataset(name: str, _defaults: dict | None = None,
                   **kwargs) -> Dataset:
     """Dataset registry keyed by config ``train.dataset``.

@@ -67,6 +67,16 @@ def main(argv: list[str] | None = None) -> int:
                    "seed": cfg.train.seed},
         **cfg.train.dataset_kwargs,
     )
+    eval_loader = None
+    if cfg.train.eval_fraction > 0:
+        from distributed_training_tpu.data.datasets import \
+            train_eval_split
+        dataset, eval_ds = train_eval_split(
+            dataset, cfg.train.eval_fraction, seed=cfg.train.seed,
+            multiple_of=cfg.train.batch_size * rt.data_shard_count)
+        eval_loader = ShardedDataLoader(
+            eval_ds, rt, batch_size=cfg.train.batch_size,
+            shuffle=False, seed=cfg.train.seed)
     loader = ShardedDataLoader(
         dataset, rt,
         batch_size=cfg.train.batch_size,
@@ -86,7 +96,7 @@ def main(argv: list[str] | None = None) -> int:
     guard = PreemptionGuard.install()
 
     trainer = Trainer(cfg, rt, model, loader, checkpointer,
-                      preemption_guard=guard)
+                      preemption_guard=guard, eval_loader=eval_loader)
     if cfg.train.profile_dir:
         from distributed_training_tpu.utils import profiler
         with profiler.trace(cfg.train.profile_dir,

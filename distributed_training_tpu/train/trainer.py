@@ -141,11 +141,14 @@ class Trainer:
     """Config-driven training orchestrator."""
 
     def __init__(self, cfg: Config, runtime: Runtime, model,
-                 loader, checkpointer=None, preemption_guard=None):
+                 loader, checkpointer=None, preemption_guard=None,
+                 eval_loader=None):
         self.cfg = cfg
         self.rt = runtime
         self.model = model
         self.loader = loader
+        self.eval_loader = eval_loader
+        self._eval_fn = None
         self.checkpointer = checkpointer
         # Cooperative stop flag (SIGTERM → save + clean exit); see
         # utils/preemption.py. None → never stops early.
@@ -329,6 +332,16 @@ class Trainer:
             if self.rt.is_coordinator:
                 logger.info("epoch %d | mean_loss %.6f", epoch,
                             summary["mean_loss"])
+            eval_every = self.cfg.train.eval_every
+            if self.eval_loader is not None and eval_every and \
+                    (epoch + 1) % eval_every == 0 and \
+                    not self._stop_agreed:
+                val_loss = self.evaluate(self.eval_loader.epoch(epoch))
+                summary["val_loss"] = val_loss
+                # Unthrottled: epoch-end eval must never be dropped by
+                # the per-step log_every window.
+                self.metrics.record_scalar(self.global_step, "val_loss",
+                                           val_loss, epoch=epoch)
             preempted = self._stop_agreed
             if self.checkpointer is not None and (
                     preempted or epoch % self.cfg.train.save_every == 0):
@@ -353,9 +366,13 @@ class Trainer:
     # -- eval --------------------------------------------------------------
 
     def evaluate(self, batches: Iterable[Mapping[str, Any]]) -> float:
-        """Mean loss over batches without updating state."""
-        eval_fn = jax.jit(
-            lambda p, b, r: self.model.loss(p, b, r, train=False)[0])
+        """Mean loss over batches without updating state (dropout off,
+        deterministic). The jitted eval fn is built once and reused."""
+        if self._eval_fn is None:
+            self._eval_fn = jax.jit(
+                lambda p, b, r: self.model.loss(p, b, r,
+                                                train=False)[0])
+        eval_fn = self._eval_fn
         losses = [float(eval_fn(self.state["params"], b, self.step_rng))
                   for b in batches]
         return float(np.mean(losses)) if losses else float("nan")

@@ -82,3 +82,14 @@ class MetricsLogger:
             f" | mfu {entry['mfu']:.3f}" if "mfu" in entry else "")
         self._last_time = now
         self._last_step = step
+
+    def record_scalar(self, step: int, name: str, value: float,
+                      epoch: int = 0) -> None:
+        """Unthrottled single-scalar entry (eval metrics, one-off
+        events). Does not touch the throughput window."""
+        if not self.enabled:
+            return
+        self.history.append({"epoch": epoch, "step": step,
+                             name: float(value)})
+        logger.info("step %d | epoch %d | %s %.6f", step, epoch, name,
+                    float(value))
