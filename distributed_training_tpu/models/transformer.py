@@ -273,12 +273,13 @@ class Transformer:
     # -- forward -----------------------------------------------------------
 
     def _block(self, x: jax.Array, layer: dict, positions: jax.Array,
-               dropout_rng: jax.Array | None = None
-               ) -> tuple[jax.Array, jax.Array]:
+               dropout_rng: jax.Array | None = None,
+               return_kv: bool = False):
         """One decoder block. x: (B, S, D) in compute dtype.
-        Returns (x, aux_loss). ``dropout_rng`` non-None enables
-        residual-branch dropout at ``cfg.dropout`` (GPT-2's
-        resid_pdrop)."""
+        Returns (x, aux_loss) — plus the post-rope (k, v) when
+        ``return_kv`` (generation prefill fills its cache from them).
+        ``dropout_rng`` non-None enables residual-branch dropout at
+        ``cfg.dropout`` (GPT-2's resid_pdrop)."""
         c = self.cfg
         dt = x.dtype
         drop = (functools.partial(_dropout, rate=c.dropout)
@@ -315,6 +316,8 @@ class Transformer:
         if drop is not None:
             mlp_out = drop(mlp_out,
                            rng=jax.random.fold_in(dropout_rng, 1))
+        if return_kv:
+            return x + mlp_out, aux, (k, v)
         return x + mlp_out, aux
 
     def apply(self, params, tokens: jax.Array,
@@ -463,6 +466,164 @@ class Transformer:
         # Trainer feeds (seq_len + 1) token rows; model consumes seq_len.
         S = self.cfg.max_seq_len
         return self.flops_per_token(S) * S
+
+    # -- generation --------------------------------------------------------
+
+    def _attend_cache(self, q, k_cache, v_cache, pos):
+        """Single-position attention: q (B, 1, H, hd) against the cache
+        (B, Sm, Hkv, hd), keys at positions <= pos. GQA-grouped like
+        ops.attention (hkv-major head order)."""
+        c = self.cfg
+        group = c.n_heads // c.n_kv_heads
+        B, Sm = k_cache.shape[0], k_cache.shape[1]
+        qg = q[:, 0].reshape(B, c.n_kv_heads, group, c.head_dim)
+        logits = jnp.einsum(
+            "bhgd,bshd->bhgs", qg, k_cache,
+            preferred_element_type=jnp.float32) * c.head_dim ** -0.5
+        mask = jnp.arange(Sm)[None, None, None, :] <= pos
+        logits = jnp.where(mask, logits, -1e30)
+        probs = jax.nn.softmax(logits, axis=-1)
+        out = jnp.einsum("bhgs,bshd->bhgd",
+                         probs.astype(v_cache.dtype), v_cache,
+                         preferred_element_type=jnp.float32)
+        return out.reshape(B, 1, c.n_heads, c.head_dim).astype(q.dtype)
+
+    def _block_decode(self, x, layer, k_cache, v_cache, pos):
+        """One block for one new token at position ``pos`` (B, 1, D),
+        reading/extending the layer's KV cache."""
+        c = self.cfg
+        dt = x.dtype
+        h = _layer_norm(x, layer["ln1"]["scale"], layer["ln1"]["bias"])
+        q = jnp.einsum("bsd,dhk->bshk", h, layer["attn"]["wq"].astype(dt))
+        k = jnp.einsum("bsd,dhk->bshk", h, layer["attn"]["wk"].astype(dt))
+        v = jnp.einsum("bsd,dhk->bshk", h, layer["attn"]["wv"].astype(dt))
+        if c.pos_encoding == "rope":
+            q, k = _rope(q, k, jnp.full((1,), pos, jnp.int32))
+        k_cache = jax.lax.dynamic_update_slice(
+            k_cache, k.astype(k_cache.dtype), (0, pos, 0, 0))
+        v_cache = jax.lax.dynamic_update_slice(
+            v_cache, v.astype(v_cache.dtype), (0, pos, 0, 0))
+        attn = self._attend_cache(q, k_cache, v_cache, pos)
+        x = x + jnp.einsum("bshk,hkd->bsd", attn,
+                           layer["attn"]["wo"].astype(dt))
+        h = _layer_norm(x, layer["ln2"]["scale"], layer["ln2"]["bias"])
+        if c.moe_num_experts > 0:
+            mlp_out, _ = _moe_mlp(h, layer["mlp"], c)
+        else:
+            m = layer["mlp"]
+            u = jax.nn.gelu(jnp.einsum("bsd,df->bsf", h,
+                                       m["wi"].astype(dt))
+                            + m["bi"].astype(dt))
+            mlp_out = jnp.einsum("bsf,fd->bsd", u, m["wo"].astype(dt)) \
+                + m["bo"].astype(dt)
+        return x + mlp_out, k_cache, v_cache
+
+    def _lm_head(self, params, x_last):
+        """(B, D) hidden → (B, V) fp32 logits (final LN + head)."""
+        x = _layer_norm(x_last, params["final_norm"]["scale"],
+                        params["final_norm"]["bias"])
+        head = (params["tok_embed"].T if self.cfg.tie_embeddings
+                else params["lm_head"])
+        return jnp.einsum("bd,dv->bv", x,
+                          head.astype(x.dtype)).astype(jnp.float32)
+
+    def prefill(self, params, tokens, max_len: int):
+        """Run the prompt (B, P) through the stack, returning per-layer
+        KV caches padded to ``max_len`` plus fp32 logits for the next
+        position: (k_cache (L,B,max_len,Hkv,hd), v_cache, logits)."""
+        c = self.cfg
+        dt = jnp.dtype(c.dtype)
+        B, P = tokens.shape
+        x = params["tok_embed"][tokens].astype(dt)
+        positions = jnp.arange(P)
+        if c.pos_encoding == "learned":
+            x = x + params["pos_embed"][:P].astype(dt)
+        stacked = {k: params[k] for k in ("ln1", "ln2", "attn", "mlp")}
+
+        def body(carry, layer):
+            x, = carry
+            x, _aux, kv = self._block(x, layer, positions,
+                                      return_kv=True)
+            return (x,), kv
+
+        (x,), (ks, vs) = jax.lax.scan(body, (x,), stacked)
+        # ks: (L, B, P, Hkv, hd) → padded caches
+        pad = [(0, 0), (0, 0), (0, max_len - P), (0, 0), (0, 0)]
+        k_cache = jnp.pad(ks.astype(dt), pad)
+        v_cache = jnp.pad(vs.astype(dt), pad)
+        return k_cache, v_cache, self._lm_head(params, x[:, -1])
+
+    def generate(self, params, prompt, max_new_tokens: int,
+                 temperature: float = 0.0, top_k: int = 0,
+                 rng: jax.Array | None = None,
+                 max_len: int | None = None) -> jax.Array:
+        """Autoregressive sampling: (B, P) int32 prompt → (B,
+        max_new_tokens) continuations. ``temperature == 0`` is greedy;
+        otherwise categorical sampling, optionally truncated to the
+        ``top_k`` most likely tokens. The whole loop (prefill + cached
+        decode scan) is jitted; no data-dependent Python control flow.
+        """
+        c = self.cfg
+        B, P = prompt.shape
+        max_len = max_len or c.max_seq_len
+        if P + max_new_tokens > max_len:
+            raise ValueError(
+                f"prompt ({P}) + max_new_tokens ({max_new_tokens}) "
+                f"exceeds max_len ({max_len})")
+        if temperature > 0 and rng is None:
+            raise ValueError("sampling (temperature > 0) needs an rng")
+        rng = rng if rng is not None else jax.random.PRNGKey(0)
+        stacked_keys = ("ln1", "ln2", "attn", "mlp")
+
+        def sample(logits, key):
+            if temperature <= 0:
+                return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            logits = logits / temperature
+            if top_k:
+                kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+                logits = jnp.where(logits < kth, -jnp.inf, logits)
+            return jax.random.categorical(key, logits).astype(jnp.int32)
+
+        def run(params, prompt, rng):
+            k_cache, v_cache, logits = self.prefill(params, prompt,
+                                                    max_len)
+            stacked = {k: params[k] for k in stacked_keys}
+            rng0, rng_loop = jax.random.split(rng)
+            tok0 = sample(logits, rng0)
+
+            def step(carry, i):
+                k_cache, v_cache, tok, key = carry
+                pos = P + i
+                x = params["tok_embed"][tok][:, None, :].astype(
+                    jnp.dtype(c.dtype))
+                if c.pos_encoding == "learned":
+                    x = x + params["pos_embed"][pos][None, None, :] \
+                        .astype(x.dtype)
+
+                def layer_body(xc, inp):
+                    layer, kc, vc = inp
+                    x, = xc
+                    x, kc, vc = self._block_decode(x, layer, kc, vc,
+                                                   pos)
+                    return (x,), (kc, vc)
+
+                (x,), (k_cache, v_cache) = jax.lax.scan(
+                    layer_body, (x,), (stacked, k_cache, v_cache))
+                logits = self._lm_head(params, x[:, 0])
+                key, sub = jax.random.split(key)
+                nxt = sample(logits, sub)
+                return (k_cache, v_cache, nxt, key), nxt
+
+            n_scan = max_new_tokens - 1
+            if n_scan > 0:
+                (_, _, _, _), rest = jax.lax.scan(
+                    step, (k_cache, v_cache, tok0, rng_loop),
+                    jnp.arange(n_scan))
+                return jnp.concatenate(
+                    [tok0[:, None], rest.T.astype(jnp.int32)], axis=1)
+            return tok0[:, None]
+
+        return jax.jit(run)(params, prompt, rng)
 
 
 def _moe_mlp(h: jax.Array, mlp: dict, c: TransformerConfig
