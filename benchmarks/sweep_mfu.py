@@ -1,0 +1,45 @@
+#!/usr/bin/env python
+"""MFU sweep harness for the headline bench (dev tool, real chip).
+
+Runs bench.py's *exact* measurement core (imported, not duplicated) at
+several batch sizes / model settings in one process and prints a JSON
+line per point — the bench config is picked from this evidence.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+
+from bench import measure  # noqa: E402  (repo-root bench.py)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batches", type=int, nargs="+", default=[8, 16, 32])
+    ap.add_argument("--seq-len", type=int, default=1024)
+    ap.add_argument("--timed-steps", type=int, default=10)
+    ap.add_argument("--model-kwargs", default="{}",
+                    help="JSON kwargs forwarded to build_model")
+    args = ap.parse_args()
+    model_kwargs = json.loads(args.model_kwargs)
+    for b in args.batches:
+        try:
+            m = measure(b, seq_len=args.seq_len,
+                        timed_steps=args.timed_steps,
+                        phase=lambda *a, **k: None, **model_kwargs)
+            m["mfu"] = round(m["mfu"], 4)
+            m["model_kwargs"] = model_kwargs
+            print(json.dumps(m), flush=True)
+        except Exception as e:  # noqa: BLE001 — sweep survives OOM points
+            print(json.dumps({"batch": b, "error": str(e)[:300]}),
+                  flush=True)
+
+
+if __name__ == "__main__":
+    main()

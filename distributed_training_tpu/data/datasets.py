@@ -92,10 +92,11 @@ class SyntheticLMDataset(ArrayDataset):
 
     def __init__(self, size: int = 1024, seq_len: int = 128,
                  vocab_size: int = 50257, seed: int = 0):
-        # Native multithreaded token fill when compiled; NumPy fallback
-        # draws a different (equally valid) stream — each is
-        # deterministic in `seed` and identical on every host, which is
-        # the property the multi-host data path relies on.
+        # Native multithreaded token fill when compiled; the NumPy
+        # fallback replays the identical SplitMix64 stream, so every
+        # host materializes the same corpus even when native build
+        # availability differs across hosts (the property the
+        # multi-host data path relies on).
         from distributed_training_tpu import native
         tokens = native.fill_tokens(
             seed, vocab_size, size * (seq_len + 1)).reshape(

@@ -67,6 +67,10 @@ class TransformerConfig:
     moe_top_k: int = 2
     moe_aux_weight: float = 0.01
     loss_name: str = "xent"
+    # "fused": chunked custom-VJP xent head (ops/xent.py) — never
+    # materializes (B, S, V) logits, the HBM hog that caps batch size.
+    # "dense": materialize fp32 logits + log_softmax (reference-style).
+    loss_impl: str = "fused"
 
     def __post_init__(self):
         if self.n_kv_heads == 0:
@@ -80,6 +84,10 @@ class TransformerConfig:
         if not 0.0 <= self.dropout < 1.0:
             raise ValueError(
                 f"dropout must be in [0, 1), got {self.dropout}")
+        if self.loss_impl not in ("fused", "dense"):
+            raise ValueError(
+                f"unknown loss_impl '{self.loss_impl}' "
+                "(expected 'fused' or 'dense')")
         if self.remat_policy not in ("full", "selective"):
             # Validate here (not only in the remat branch of apply) so
             # a typo surfaces at construction even with remat=False or
@@ -161,6 +169,11 @@ class Transformer:
         constructed against a concrete mesh)."""
         self.mesh = mesh
 
+    def _mesh_axis_sizes(self) -> dict:
+        if self.mesh is None:
+            return {}
+        return dict(zip(self.mesh.axis_names, self.mesh.devices.shape))
+
     def _attention(self, q, k, v):
         c = self.cfg
         if c.attention_impl == "ring":
@@ -172,8 +185,7 @@ class Transformer:
                 raise ValueError(
                     "attention_impl='ring' requires bind_mesh(mesh) "
                     "before tracing (the Trainer does this)")
-            sizes = dict(zip(self.mesh.axis_names,
-                             self.mesh.devices.shape))
+            sizes = self._mesh_axis_sizes()
             head_ax = AXIS_TP if sizes.get(AXIS_TP, 1) > 1 else None
             fn = make_ring_attention(self.mesh, causal=True,
                                      head_axis=head_ax)
@@ -320,13 +332,13 @@ class Transformer:
             return x + mlp_out, aux, (k, v)
         return x + mlp_out, aux
 
-    def apply(self, params, tokens: jax.Array,
-              rng: jax.Array | None = None, train: bool = False
-              ) -> tuple[jax.Array, jax.Array]:
-        """tokens (B, S) int32 → logits (B, S, V) fp32, aux loss scalar.
-
-        Dropout (``cfg.dropout > 0``) is active only when ``train`` and
-        an ``rng`` is given; eval/inference is deterministic."""
+    def _trunk(self, params, tokens: jax.Array,
+               rng: jax.Array | None = None, train: bool = False
+               ) -> tuple[jax.Array, jax.Array]:
+        """tokens (B, S) → final-norm hidden states (B, S, D) in compute
+        dtype, plus the MoE aux-loss scalar. Everything except the
+        unembedding projection (the loss path feeds these straight into
+        the fused xent head, ops/xent.py)."""
         c = self.cfg
         dt = jnp.dtype(c.dtype)
         B, S = tokens.shape
@@ -343,10 +355,7 @@ class Transformer:
         # leading L dim.
         stacked = {k: params[k] for k in ("ln1", "ln2", "attn", "mlp")}
 
-        pp = 1
-        if self.mesh is not None:
-            pp = dict(zip(self.mesh.axis_names,
-                          self.mesh.devices.shape)).get("pp", 1)
+        pp = self._mesh_axis_sizes().get("pp", 1)
 
         if dropping:
             layer_rngs = jax.random.split(
@@ -420,9 +429,23 @@ class Transformer:
 
         x = _layer_norm(x, params["final_norm"]["scale"],
                         params["final_norm"]["bias"])
-        head = (params["tok_embed"].T if c.tie_embeddings
+        return x, aux
+
+    def _head(self, params) -> jax.Array:
+        """Unembedding matrix (D, V) in param dtype."""
+        return (params["tok_embed"].T if self.cfg.tie_embeddings
                 else params["lm_head"])
-        logits = jnp.einsum("bsd,dv->bsv", x, head.astype(dt))
+
+    def apply(self, params, tokens: jax.Array,
+              rng: jax.Array | None = None, train: bool = False
+              ) -> tuple[jax.Array, jax.Array]:
+        """tokens (B, S) int32 → logits (B, S, V) fp32, aux loss scalar.
+
+        Dropout (``cfg.dropout > 0``) is active only when ``train`` and
+        an ``rng`` is given; eval/inference is deterministic."""
+        x, aux = self._trunk(params, tokens, rng=rng, train=train)
+        logits = jnp.einsum("bsd,dv->bsv", x,
+                            self._head(params).astype(x.dtype))
         return logits.astype(jnp.float32), aux
 
     # -- loss --------------------------------------------------------------
@@ -430,11 +453,26 @@ class Transformer:
     def loss(self, params, batch, rng: jax.Array, train: bool = True):
         tokens = batch["tokens"]
         inputs, targets = tokens[:, :-1], tokens[:, 1:]
-        logits, aux = self.apply(params, inputs, rng=rng, train=train)
-        logp = jax.nn.log_softmax(logits, axis=-1)
-        nll = -jnp.take_along_axis(logp, targets[..., None],
-                                   axis=-1)[..., 0]
-        loss = jnp.mean(nll)
+        if self.cfg.loss_impl == "fused":
+            from distributed_training_tpu.ops.xent import lm_cross_entropy
+            x, aux = self._trunk(params, inputs, rng=rng, train=train)
+            nll = lm_cross_entropy(x, self._head(params).astype(x.dtype),
+                                   targets)
+            # Negative target ids are masked pad positions (zero nll &
+            # gradient inside the op) — average over real tokens only.
+            valid = jnp.sum(targets >= 0)
+            loss = jnp.sum(nll) / jnp.maximum(valid, 1)
+        else:
+            logits, aux = self.apply(params, inputs, rng=rng, train=train)
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            nll = -jnp.take_along_axis(
+                logp, jnp.maximum(targets, 0)[..., None],
+                axis=-1)[..., 0]
+            # Same masking contract as the fused path: negative target
+            # ids are pad positions with zero loss contribution.
+            nll = jnp.where(targets >= 0, nll, 0.0)
+            valid = jnp.sum(targets >= 0)
+            loss = jnp.sum(nll) / jnp.maximum(valid, 1)
         metrics = {"loss": loss, "perplexity": jnp.exp(loss)}
         if self.cfg.moe_num_experts > 0:
             loss = loss + self.cfg.moe_aux_weight * aux

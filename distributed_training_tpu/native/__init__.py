@@ -4,9 +4,10 @@ Build-on-first-import: compiles ``dtt_native.cpp`` with g++ into a
 shared library cached beside the source (keyed on a source hash, so
 edits rebuild automatically). Everything degrades gracefully — if no
 compiler is present or the build fails, ``available()`` is False and
-callers (data/datasets.py) fall back to NumPy — ``gather_rows`` is
-exact-equal either way, just single-threaded; ``fill_tokens`` draws a
-different (equally valid, equally deterministic) stream.
+callers (data/datasets.py) fall back to NumPy. Both entry points are
+**bit-identical** across paths (gather: same fancy-index semantics;
+fill_tokens: the NumPy path replays the native SplitMix64 stream) —
+only speed differs, never data.
 
 This is the framework's native runtime component for host-side IO: the
 TPU analogue of torch's C++ DataLoader workers the reference trains
@@ -52,9 +53,15 @@ def _compile(path: str) -> None:
     # -march=native is safe: the .so is cached per machine, not shipped.
     cmd = ["g++", "-O3", "-march=native", "-std=c++17", "-shared",
            "-fPIC", "-pthread", _SRC, "-o", path]
-    tmp = tempfile.mktemp(suffix=".so", dir=os.path.dirname(path))
-    subprocess.run(cmd[:-1] + [tmp], check=True, capture_output=True)
-    os.replace(tmp, path)  # atomic under concurrent builders
+    fd, tmp = tempfile.mkstemp(suffix=".so", dir=os.path.dirname(path))
+    os.close(fd)  # g++ rewrites the (safely created) file in place
+    try:
+        subprocess.run(cmd[:-1] + [tmp], check=True,
+                       capture_output=True)
+        os.replace(tmp, path)  # atomic under concurrent builders
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
 
 
 def _load() -> ctypes.CDLL | None:
@@ -126,15 +133,50 @@ def gather_rows(src: np.ndarray, indices: np.ndarray,
     return out
 
 
+_FILL_BLOCK = 4096  # must match dtt_native.cpp's block constant
+_SM64_GAMMA = 0x9E3779B97F4A7C15
+_SM64_M1 = 0xBF58476D1CE4E5B9
+_SM64_M2 = 0x94D4A2CA9C8DE917
+_FILL_STREAM = 0xD1342543DE82EF95
+
+
+def _fill_tokens_numpy(seed: int, vocab: int, n: int) -> np.ndarray:
+    """Vectorized uint64 NumPy reproduction of the native SplitMix64
+    stream (dtt_native.cpp: dtt_fill_tokens) — *bit-identical* output.
+
+    This matters on multi-host pods: every host builds the synthetic
+    corpus locally and the data path assumes the copies are identical.
+    If native build availability differed across hosts and the fallback
+    drew a different stream, per-host corpora would silently diverge
+    (the ADVICE.md round-1 medium finding) — so the fallback is exact,
+    not merely "equally valid".
+
+    Per 4096-token block ``b``: state ``s0 = seed ^ (STREAM * (b+1))``;
+    draw ``i`` mixes ``s0 + (i+1) * GAMMA`` through the SplitMix64
+    finalizer; token = mix % vocab. All modular uint64 — NumPy unsigned
+    arithmetic wraps exactly like C.
+    """
+    n_blocks = (n + _FILL_BLOCK - 1) // _FILL_BLOCK
+    seed_u = np.uint64(seed & 0xFFFFFFFFFFFFFFFF)
+    b = np.arange(1, n_blocks + 1, dtype=np.uint64)
+    s0 = seed_u ^ (np.uint64(_FILL_STREAM) * b)          # (n_blocks,)
+    i = np.arange(1, _FILL_BLOCK + 1, dtype=np.uint64)
+    z = s0[:, None] + i[None, :] * np.uint64(_SM64_GAMMA)
+    z = (z ^ (z >> np.uint64(30))) * np.uint64(_SM64_M1)
+    z = (z ^ (z >> np.uint64(27))) * np.uint64(_SM64_M2)
+    z = z ^ (z >> np.uint64(31))
+    return (z % np.uint64(vocab)).astype(np.int32).reshape(-1)[:n]
+
+
 def fill_tokens(seed: int, vocab: int, n: int,
                 n_threads: int = DEFAULT_THREADS) -> np.ndarray:
     """n int32 tokens uniform in [0, vocab), deterministic in seed
-    (thread-count independent)."""
+    (thread-count independent). Native and NumPy paths produce
+    bit-identical streams, so mixed-availability hosts agree."""
     out = np.empty(n, dtype=np.int32)
     lib = _load()
     if lib is None:
-        rng = np.random.default_rng(seed)
-        return rng.integers(0, vocab, size=n, dtype=np.int32)
+        return _fill_tokens_numpy(seed, vocab, n)
     lib.dtt_fill_tokens(
         seed, vocab, out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
         n, n_threads)

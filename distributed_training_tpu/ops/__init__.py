@@ -9,3 +9,6 @@ function over arrays so models stay kernel-agnostic; dispatch is by
 from distributed_training_tpu.ops.attention import (  # noqa: F401
     dot_product_attention,
 )
+from distributed_training_tpu.ops.xent import (  # noqa: F401
+    lm_cross_entropy,
+)

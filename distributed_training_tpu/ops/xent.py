@@ -1,0 +1,142 @@
+"""Memory-efficient LM softmax cross-entropy (fused, chunked head).
+
+The naive loss head materializes ``(B, S, V)`` fp32 logits *and* their
+log-softmax for the backward pass — at GPT-2 vocab (50304) that is the
+single largest buffer in the whole train step (3.3 GB at B=16, S=1024)
+and caps the batch size far below what the rest of the model needs to
+saturate the MXU.
+
+This op computes per-token ``nll = logsumexp(x @ head) - (x @ head)[t]``
+in row chunks under ``lax.scan`` and registers a custom VJP that
+*recomputes* each chunk's logits in the backward pass instead of saving
+them:
+
+- forward residuals: ``x`` (bf16, B·S·D), ``head``, ``targets`` and the
+  per-token ``lse`` (fp32, B·S) — no (N, V) buffer survives the scan;
+- backward: per chunk, ``dlogits = (softmax - onehot) * dnll`` feeds the
+  two head matmuls (dx, dhead) directly, fp32 accumulation on the MXU;
+- extra cost is one logits recompute (+2·B·S·D·V FLOPs, ~3% of a 125M
+  step) traded for gigabytes of HBM — the classic TPU trade.
+
+No reference counterpart (its models are Linear stubs and its loss is
+the degenerate ``F.cross_entropy`` of src/distributed_trainer.py:163;
+SURVEY.md §8 B5) — this exists to hit the BASELINE.json MFU target.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+DEFAULT_CHUNK_ROWS = 2048
+
+
+def _pad_rows(n: int, chunk: int) -> int:
+    return (-n) % chunk
+
+
+def _chunked(x2: jax.Array, t1: jax.Array, chunk: int):
+    """(N, D) rows + (N,) targets → (C, chunk, D) / (C, chunk), padding
+    with target −1 (masked out downstream)."""
+    n = x2.shape[0]
+    pad = _pad_rows(n, chunk)
+    if pad:
+        x2 = jnp.concatenate(
+            [x2, jnp.zeros((pad, x2.shape[1]), x2.dtype)], axis=0)
+        t1 = jnp.concatenate(
+            [t1, jnp.full((pad,), -1, t1.dtype)], axis=0)
+    c = x2.shape[0] // chunk
+    return x2.reshape(c, chunk, -1), t1.reshape(c, chunk)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _lm_xent_rows(x2, head, t1, chunk):
+    nll, _ = _fwd_scan(x2, head, t1, chunk)
+    return nll
+
+
+def _fwd_scan(x2, head, t1, chunk):
+    n = x2.shape[0]
+    xc, tc = _chunked(x2, t1, chunk)
+
+    def body(_, inp):
+        xb, tb = inp                        # (chunk, D), (chunk,)
+        logits = jax.lax.dot_general(
+            xb, head, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)      # (chunk, V) fp32
+        m = jnp.max(logits, axis=-1)
+        lse = m + jnp.log(jnp.sum(jnp.exp(logits - m[:, None]),
+                                  axis=-1))
+        tgt = jnp.take_along_axis(
+            logits, jnp.maximum(tb, 0)[:, None], axis=-1)[:, 0]
+        nll = jnp.where(tb >= 0, lse - tgt, 0.0)
+        return 0, (nll, lse)
+
+    _, (nll, lse) = jax.lax.scan(body, 0, (xc, tc))
+    return nll.reshape(-1)[:n], lse.reshape(-1)
+
+
+def _lm_xent_fwd(x2, head, t1, chunk):
+    nll, lse = _fwd_scan(x2, head, t1, chunk)
+    return nll, (x2, head, t1, lse)
+
+
+def _lm_xent_bwd(chunk, res, dnll):
+    x2, head, t1, lse = res
+    n = x2.shape[0]
+    xc, tc = _chunked(x2, t1, chunk)
+    pad = _pad_rows(n, chunk)
+    dnll_p = (jnp.concatenate([dnll, jnp.zeros((pad,), dnll.dtype)])
+              if pad else dnll)
+    dc = dnll_p.reshape(-1, chunk)
+    lc = lse.reshape(-1, chunk)
+
+    def body(dhead_acc, inp):
+        xb, tb, db, lb = inp
+        logits = jax.lax.dot_general(
+            xb, head, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)      # recomputed
+        p = jnp.exp(logits - lb[:, None])            # softmax, fp32
+        valid = (tb >= 0)
+        onehot = jax.nn.one_hot(jnp.maximum(tb, 0), head.shape[1],
+                                dtype=jnp.float32)
+        g = jnp.where(valid, db, 0.0).astype(jnp.float32)
+        dlogits = ((p - onehot) * g[:, None]).astype(x2.dtype)
+        dxb = jax.lax.dot_general(
+            dlogits, head, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32).astype(x2.dtype)
+        dhead_acc = dhead_acc + jax.lax.dot_general(
+            xb, dlogits, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return dhead_acc, dxb
+
+    dhead, dx = jax.lax.scan(
+        body, jnp.zeros(head.shape, jnp.float32), (xc, tc, dc, lc))
+    dx = dx.reshape(-1, x2.shape[1])[:n]
+    return dx, dhead.astype(head.dtype), None
+
+
+_lm_xent_rows.defvjp(_lm_xent_fwd, _lm_xent_bwd)
+
+
+def lm_cross_entropy(x: jax.Array, head: jax.Array, targets: jax.Array,
+                     chunk_rows: int = DEFAULT_CHUNK_ROWS) -> jax.Array:
+    """Per-token LM loss without an (N, V) residual.
+
+    Args:
+      x: final hidden states ``(B, S, D)`` (any float dtype; matmuls
+        accumulate fp32 on the MXU).
+      head: unembedding ``(D, V)``.
+      targets: int token ids ``(B, S)``; negative ids are masked (their
+        nll and gradient contribution are exactly zero).
+      chunk_rows: rows per scan step — the only (rows, V) fp32 buffer
+        ever alive.
+
+    Returns per-token nll ``(B, S)`` fp32.
+    """
+    b, s, d = x.shape
+    nll = _lm_xent_rows(x.reshape(b * s, d), head,
+                        targets.reshape(b * s), chunk_rows)
+    return nll.reshape(b, s)

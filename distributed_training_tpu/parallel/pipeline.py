@@ -120,11 +120,24 @@ def pipeline_apply(body_fn: Callable, stacked_params, x: jax.Array,
     if L % pp:
         raise ValueError(f"{L} layers not divisible by {pp} stages")
 
-    x_mb = x.reshape(M, B // M, *x.shape[1:])
+    # STRIDED microbatch split (microbatch m = rows m, m+M, m+2M, ...),
+    # not contiguous chunks: each device's contiguous batch shard then
+    # contributes the same dim-1 slot to every microbatch, so rows never
+    # leave their home device. A contiguous (M, B/M, ...) reshape of the
+    # (dp, fsdp)-sharded batch dim is a physical relayout, which GSPMD
+    # resolves with an involuntary full rematerialization at the
+    # shard_map boundary (replicate + repartition, every step). The
+    # explicit constraints pin the boundary layout to the in/out specs
+    # so the compiler can't shard the microbatch dim over pp either.
+    from jax.sharding import NamedSharding
+    x_mb = jnp.swapaxes(
+        x.reshape(B // M, M, *x.shape[1:]), 0, 1)
 
     param_specs = jax.tree.map(
         lambda leaf: pipeline_spec(leaf.ndim), stacked_params)
     xspec = P(None, tuple(batch_axes) or None, None, None)
+    x_mb = jax.lax.with_sharding_constraint(
+        x_mb, NamedSharding(mesh, xspec))
 
     fn = shard_map(
         functools.partial(_pipelined, body_fn=body_fn,
@@ -135,4 +148,7 @@ def pipeline_apply(body_fn: Callable, stacked_params, x: jax.Array,
         check_rep=False,
     )
     out_mb, aux = fn(stacked_params, x_mb, jnp.zeros((), jnp.float32))
-    return out_mb.reshape(B, *x.shape[1:]), aux
+    out_mb = jax.lax.with_sharding_constraint(
+        out_mb, NamedSharding(mesh, xspec))
+    out = jnp.swapaxes(out_mb, 0, 1).reshape(B, *x.shape[1:])
+    return out, aux

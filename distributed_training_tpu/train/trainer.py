@@ -367,12 +367,28 @@ class Trainer:
 
     def evaluate(self, batches: Iterable[Mapping[str, Any]]) -> float:
         """Mean loss over batches without updating state (dropout off,
-        deterministic). The jitted eval fn is built once and reused."""
+        deterministic). The jitted eval fn is built once and reused.
+
+        Dispatch-friendly by construction: the fn is jitted with the
+        same state/batch shardings as the train step (no silent
+        reshards), and per-batch losses are accumulated on device — the
+        host syncs exactly once per evaluation, not once per batch,
+        so eval batches dispatch asynchronously like train steps do."""
         if self._eval_fn is None:
             self._eval_fn = jax.jit(
                 lambda p, b, r: self.model.loss(p, b, r,
-                                                train=False)[0])
+                                                train=False)[0],
+                in_shardings=(self.state_shardings["params"],
+                              self.batch_sharding, None),
+                out_shardings=NamedSharding(self.rt.mesh, P()),
+            )
         eval_fn = self._eval_fn
-        losses = [float(eval_fn(self.state["params"], b, self.step_rng))
-                  for b in batches]
-        return float(np.mean(losses)) if losses else float("nan")
+        total = None
+        count = 0
+        for b in batches:
+            loss = eval_fn(self.state["params"], b, self.step_rng)
+            total = loss if total is None else total + loss
+            count += 1
+        if count == 0:
+            return float("nan")
+        return float(total) / count

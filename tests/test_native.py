@@ -111,3 +111,37 @@ def test_array_dataset_uses_gather():
     got = ds.batch(np.array([3, 1, 3]))
     np.testing.assert_array_equal(got["x"], x[[3, 1, 3]])
     np.testing.assert_array_equal(got["y"], y[[3, 1, 3]])
+
+
+@pytest.mark.parametrize("n", [1, 100, 4096, 4097, 100_000])
+def test_fill_tokens_numpy_fallback_bit_identical(n):
+    """The NumPy fallback must replay the native SplitMix64 stream
+    exactly — mixed native-availability across pod hosts must never
+    produce divergent per-host corpora (ADVICE.md round-1 medium)."""
+    assert native.available()
+    a = native.fill_tokens(seed=7, vocab=50257, n=n)
+    b = native._fill_tokens_numpy(seed=7, vocab=50257, n=n)
+    np.testing.assert_array_equal(a, b)
+    # Negative / huge seeds hit the uint64 wrap paths.
+    for seed in (-3, 2**63 + 11):
+        np.testing.assert_array_equal(
+            native.fill_tokens(seed=seed, vocab=997, n=5000),
+            native._fill_tokens_numpy(seed=seed, vocab=997, n=5000))
+
+
+def test_fill_tokens_fallback_used_when_disabled(monkeypatch):
+    """DTT_NATIVE_DISABLE forces the fallback through the public API."""
+    import importlib
+
+    import distributed_training_tpu.native as nat
+    monkeypatch.setenv("DTT_NATIVE_DISABLE", "1")
+    fresh = importlib.reload(nat)
+    try:
+        assert not fresh.available()
+        got = fresh.fill_tokens(seed=11, vocab=1000, n=9000)
+    finally:
+        monkeypatch.delenv("DTT_NATIVE_DISABLE")
+        importlib.reload(nat)
+    expect = nat.fill_tokens(seed=11, vocab=1000, n=9000)
+    assert nat.available()
+    np.testing.assert_array_equal(got, expect)
