@@ -66,6 +66,13 @@ class TransformerConfig:
     moe_num_experts: int = 0
     moe_top_k: int = 2
     moe_aux_weight: float = 0.01
+    # "routed": capacity-bounded top-k dispatch (FLOPs ~independent of
+    # the expert count at fixed top_k). "dense": every expert computes
+    # every token, then masks — exact, O(E) FLOPs; kept as the
+    # numerics reference and for tiny expert counts.
+    moe_impl: str = "routed"
+    moe_capacity_factor: float = 1.25
+    moe_group_size: int = 1024    # tokens per dispatch group (cap)
     loss_name: str = "xent"
     # "fused": chunked custom-VJP xent head (ops/xent.py) — never
     # materializes (B, S, V) logits, the HBM hog that caps batch size.
@@ -84,6 +91,10 @@ class TransformerConfig:
         if not 0.0 <= self.dropout < 1.0:
             raise ValueError(
                 f"dropout must be in [0, 1), got {self.dropout}")
+        if self.moe_impl not in ("routed", "dense"):
+            raise ValueError(
+                f"unknown moe_impl '{self.moe_impl}' "
+                "(expected 'routed' or 'dense')")
         if self.loss_impl not in ("fused", "dense"):
             raise ValueError(
                 f"unknown loss_impl '{self.loss_impl}' "
@@ -664,36 +675,101 @@ class Transformer:
         return jax.jit(run)(params, prompt, rng)
 
 
-def _moe_mlp(h: jax.Array, mlp: dict, c: TransformerConfig
-             ) -> tuple[jax.Array, jax.Array]:
-    """Top-k routed expert MLP with dense one-hot dispatch.
-
-    Dense dispatch (einsum over the expert dim) compiles to pure MXU work
-    and shards cleanly: experts live on the ``expert``-sharded params, so
-    under an EP layout XLA partitions the expert einsums across the mesh.
-    Aux loss is the standard load-balancing term (mean_prob · mean_assign
-    · E). For very large E a Pallas a2a dispatch is the upgrade path.
-    """
+def _moe_router(h: jax.Array, mlp: dict, c: TransformerConfig):
+    """Shared routing head: normalized top-k weights/indices + the
+    Switch/GShard load-balancing aux (E · Σ_e mean_prob_e · mean_frac_e),
+    computed pre-capacity so the balance signal sees dropped tokens."""
     dt = h.dtype
     E, k = c.moe_num_experts, c.moe_top_k
-    gates = jnp.einsum("bsd,de->bse", h, mlp["router"].astype(dt))
+    gates = jnp.einsum("...d,de->...e", h, mlp["router"].astype(dt))
     probs = jax.nn.softmax(gates.astype(jnp.float32), axis=-1)
-    topv, topi = jax.lax.top_k(probs, k)           # (B, S, k)
+    topv, topi = jax.lax.top_k(probs, k)              # (..., k)
     topv = topv / jnp.sum(topv, axis=-1, keepdims=True)
-    onehot = jax.nn.one_hot(topi, E, dtype=jnp.float32)  # (B,S,k,E)
-    combine = jnp.einsum("bsk,bske->bse", topv, onehot)  # (B,S,E)
+    onehot = jax.nn.one_hot(topi, E, dtype=jnp.float32)  # (..., k, E)
+    red = tuple(range(probs.ndim - 1))
+    frac = jnp.mean(jnp.sum(onehot, axis=-2), axis=red)      # (E,)
+    mean_prob = jnp.mean(probs, axis=red)                    # (E,)
+    aux = E * jnp.sum(frac * mean_prob)
+    return topv, onehot, aux
 
+
+def _moe_mlp_dense(h, mlp, c: TransformerConfig):
+    """Reference dispatch: every expert computes every token, masked
+    combine. Exact but O(E) FLOPs — numerics baseline for the routed
+    path and the sane choice for very small E."""
+    dt = h.dtype
+    topv, onehot, aux = _moe_router(h, mlp, c)
+    combine = jnp.einsum("bsk,bske->bse", topv, onehot)  # (B,S,E)
     up = jnp.einsum("bsd,edf->besf", h, mlp["wi"].astype(dt))
     up = jax.nn.gelu(up)
     down = jnp.einsum("besf,efd->besd", up, mlp["wo"].astype(dt))
     out = jnp.einsum("besd,bse->bsd", down, combine.astype(dt))
-
-    # load-balancing aux (Switch/GShard): E * sum_e mean_prob_e *
-    # mean_frac_e
-    frac = jnp.mean(jnp.sum(onehot, axis=2), axis=(0, 1))    # (E,)
-    mean_prob = jnp.mean(probs, axis=(0, 1))                 # (E,)
-    aux = E * jnp.sum(frac * mean_prob)
     return out, aux
+
+
+def _moe_group_size(T: int, cap: int) -> int:
+    """Largest divisor of T that is <= cap (dispatch-tensor bound)."""
+    g = min(T, max(1, cap))
+    while T % g:
+        g -= 1
+    return g
+
+
+def _moe_mlp_routed(h, mlp, c: TransformerConfig):
+    """Capacity-bounded top-k dispatch (GShard-style, TPU-first).
+
+    Tokens are flattened, split into groups of ≤ ``moe_group_size``, and
+    each group routes its tokens into per-expert capacity buffers
+    ``C = ceil(cf · k · g / E)``: position-in-expert comes from a
+    slot-major cumsum (slot 0 beats slot 1 on overflow — earlier/higher
+    top-k choices win buffer slots), overflowing tokens are dropped
+    (their combine weight never lands in a buffer slot, standard GShard
+    semantics). Dispatch/combine are one-hot einsums — pure MXU work
+    that shards over the ``expert`` axis under EP — and expert FLOPs are
+    ``4·D·F·cf·k·T``: independent of E at fixed top_k, vs the dense
+    path's O(E). Grouping bounds the (g, E, C) dispatch tensor and the
+    dispatch-einsum FLOPs (``g·D·cf·k·T``), which would otherwise rival
+    the expert compute itself at large T.
+    """
+    dt = h.dtype
+    E, k = c.moe_num_experts, c.moe_top_k
+    B, S, D = h.shape
+    T = B * S
+    g = _moe_group_size(T, c.moe_group_size)
+    G = T // g
+    C = int(-(-c.moe_capacity_factor * k * g // E))  # ceil
+    C = min(C, g * k)  # can't hold more than every (token, slot)
+
+    x = h.reshape(G, g, D)
+    topv, onehot, aux = _moe_router(x, mlp, c)
+    # (G, g, k, E) -> slot-major (G, k·g, E): all slot-0 rows first, so
+    # the running count gives slot 0 strictly higher buffer priority.
+    oh = onehot.transpose(0, 2, 1, 3).reshape(G, k * g, E)
+    pos = (jnp.cumsum(oh, axis=1) * oh - 1.0) \
+        .astype(jnp.int32)                            # (G, k·g, E)
+    # one_hot maps out-of-range indices to the zero vector, which IS
+    # the drop: unselected entries (pos == -1) and capacity overflow
+    # (pos >= C) land in no buffer slot.
+    slot = jax.nn.one_hot(pos, C, dtype=jnp.float32)  # (G, k·g, E, C)
+    w = topv.transpose(0, 2, 1).reshape(G, k * g)     # slot-major wts
+    combine = jnp.einsum("gt,gtec->gtec", w, slot) \
+        .reshape(G, k, g, E, C).sum(axis=1)           # (G, g, E, C)
+    dispatch = combine > 0.0
+
+    expert_in = jnp.einsum("gsec,gsd->gecd", dispatch.astype(dt), x)
+    up = jnp.einsum("gecd,edf->gecf", expert_in, mlp["wi"].astype(dt))
+    up = jax.nn.gelu(up)
+    down = jnp.einsum("gecf,efd->gecd", up, mlp["wo"].astype(dt))
+    out = jnp.einsum("gsec,gecd->gsd", combine.astype(dt), down)
+    return out.reshape(B, S, D), aux
+
+
+def _moe_mlp(h: jax.Array, mlp: dict, c: TransformerConfig
+             ) -> tuple[jax.Array, jax.Array]:
+    """Top-k routed expert MLP; dispatch per ``cfg.moe_impl``."""
+    if c.moe_impl == "routed":
+        return _moe_mlp_routed(h, mlp, c)
+    return _moe_mlp_dense(h, mlp, c)
 
 
 def build_transformer(name: str, loss: str = "auto",

@@ -3,9 +3,9 @@
 Long-context training shards the *sequence* dimension across devices;
 attention then needs every query shard to see every KV shard. Ring
 attention does this with O(S/sp) *attention-matrix* memory per device
-(never materializing S×S scores; KV-block residuals for backward are
-O(S) like the inputs — see the remat note at the scan) and
-bandwidth-optimal neighbor exchanges: KV blocks rotate around the ``sp`` ring via
+(never materializing S×S scores; backward residuals are O(S_local) —
+see the reverse-ring VJP below) and bandwidth-optimal neighbor
+exchanges: KV blocks rotate around the ``sp`` ring via
 ``jax.lax.ppermute`` (XLA lowers it to ICI collective-permute) while each
 device folds the incoming block into its queries' running online-softmax
 state — the distributed generalization of the flash-attention recurrence
@@ -20,9 +20,17 @@ the KV block of device ``(i - t) mod sp`` to device ``i``; that block is
 
 The rotation runs a full cycle regardless (uniform collective schedule
 on every device — no data-dependent communication), so causal skipping
-saves FLOPs, not bandwidth. Backward is plain autodiff through the
-``lax.scan``: ``ppermute``'s transpose is the inverse permute, giving
-the reverse KV/gradient ring for free.
+saves FLOPs, not bandwidth.
+
+Backward is a REVERSE-RING custom VJP, not autodiff: autodiff through
+the scan would save each step's rotated KV carries (O(S_global) per
+device — the memory scaling ring attention exists to avoid). Instead
+the backward pass re-rotates the *original* KV blocks around the ring a
+second time, recomputing each step's normalized softmax from the saved
+per-row logsumexp (``p = exp(s - lse)``, the FlashAttention-2 trick)
+while dk/dv partial sums travel WITH their KV block — after the full
+cycle each block's gradient arrives back at its home device. Residuals
+per device: q, k, v, out, lse — all O(S_local).
 
 The reference repo has nothing like this (no attention at all,
 SURVEY.md §5.7); it exists because long-context is first-class here.
@@ -72,31 +80,22 @@ def _merge(o_a, m_a, l_a, o_b, m_b, l_b):
             m, l_a * wa + l_b * wb)
 
 
-def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
-                   axis_name: str = AXIS_SP,
-                   causal: bool = True) -> jax.Array:
-    """Sequence-parallel attention; call INSIDE shard_map.
+def _ring_perm(sp: int):
+    """Rotate right: device i sends to i+1, so at step t device i holds
+    the block originating at (i - t) mod sp."""
+    return [(i, (i + 1) % sp) for i in range(sp)]
 
-    Shapes are per-device shards: q/k/v (B, S_local, H|Hkv, D) where the
-    global sequence is the concatenation of shards in ``axis_name``
-    order. Output matches q's shape/dtype.
-    """
+
+def _ring_fwd_scan(q, k, v, axis_name: str, causal: bool):
+    """Full ring cycle of online-softmax accumulation. Returns the
+    normalized output (B, S, H, D) and per-row logsumexp
+    (B, Hkv, g, S) fp32."""
     sp = jax.lax.axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     B, S, H, D = q.shape
     Hkv = k.shape[2]
     group = H // Hkv
-
-    if sp == 1:
-        o, m, l = _block_attn_with_lse(q, k, v,
-                                       "causal" if causal else "full")
-        out = o / jnp.maximum(l, 1e-30)[..., None]
-        return out.transpose(0, 3, 1, 2, 4).reshape(B, S, H, D) \
-            .astype(q.dtype)
-
-    # rotate right: device i sends its block to i+1, so at step t we
-    # hold the block originating at (idx - t) mod sp.
-    perm = [(i, (i + 1) % sp) for i in range(sp)]
+    perm = _ring_perm(sp)
 
     o0 = jnp.zeros((B, Hkv, group, S, D), jnp.float32)
     m0 = jnp.full((B, Hkv, group, S), -1e30, jnp.float32)
@@ -133,20 +132,151 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
         return (k_nxt, v_nxt, o_acc, m_acc, l_acc), None
 
-    # Remat the step: without it, autodiff saves each step's (Sq × Sk)
-    # softmax intermediates — the quadratic-memory term ring attention
-    # exists to avoid. With remat, backward residuals are the per-step
-    # carries (the rotated KV blocks): O(S_global) per device, like the
-    # inputs themselves. A custom reverse-ring VJP that re-rotates KV
-    # instead of saving it (true O(S_local)) is the known upgrade path.
     (k_f, v_f, o_acc, m_acc, l_acc), _ = jax.lax.scan(
-        jax.checkpoint(step, prevent_cse=False), (k, v, o0, m0, l0),
-        jnp.arange(sp))
+        step, (k, v, o0, m0, l0), jnp.arange(sp))
     del k_f, v_f
 
-    out = o_acc / jnp.maximum(l_acc, 1e-30)[..., None]
-    out = out.transpose(0, 3, 1, 2, 4).reshape(B, S, H, D)
-    return out.astype(q.dtype)
+    l_safe = jnp.maximum(l_acc, 1e-30)
+    out = o_acc / l_safe[..., None]
+    out = out.transpose(0, 3, 1, 2, 4).reshape(B, S, H, D) \
+        .astype(q.dtype)
+    lse = m_acc + jnp.log(l_safe)                 # (B, Hkv, g, S)
+    return out, lse
+
+
+def _block_grads(q, k, v, do_g, lse, delta, mode: str):
+    """Gradients of one KV block against the local queries, with the
+    softmax recomputed from the saved logsumexp (``p = exp(s - lse)`` is
+    the *normalized* softmax — no second normalizer pass needed).
+
+    q: (B, Sq, H, D); k/v: (B, Sk, Hkv, D); do_g: (B, Hkv, g, Sq, D)
+    fp32; lse/delta: (B, Hkv, g, Sq) fp32. Returns (dq (B,Sq,H,D) f32,
+    dk (B,Sk,Hkv,D) f32, dv likewise)."""
+    B, Sq, H, D = q.shape
+    Sk = k.shape[1]
+    Hkv = k.shape[2]
+    group = H // Hkv
+    scale = D ** -0.5
+    qg = q.reshape(B, Sq, Hkv, group, D)
+    s = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k,
+                   preferred_element_type=jnp.float32) * scale
+    if mode == "causal":
+        mask = (jnp.arange(Sk)[None, :]
+                <= (jnp.arange(Sq)[:, None] + (Sk - Sq)))
+        s = jnp.where(mask[None, None, None], s, -jnp.inf)
+    p = jnp.exp(s - lse[..., None])                  # (B,Hkv,g,Sq,Sk)
+    dv = jnp.einsum("bhgqk,bhgqd->bkhd", p, do_g,
+                    preferred_element_type=jnp.float32)
+    dp = jnp.einsum("bhgqd,bkhd->bhgqk", do_g, v.astype(jnp.float32),
+                    preferred_element_type=jnp.float32)
+    ds = p * (dp - delta[..., None]) * scale
+    dq = jnp.einsum("bhgqk,bkhd->bqhgd", ds, k.astype(jnp.float32),
+                    preferred_element_type=jnp.float32)
+    dk = jnp.einsum("bhgqk,bqhgd->bkhd", ds, qg.astype(jnp.float32),
+                    preferred_element_type=jnp.float32)
+    return dq.reshape(B, Sq, H, D), dk, dv
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _ring_core(q, k, v, axis_name, causal):
+    out, _ = _ring_fwd_scan(q, k, v, axis_name, causal)
+    return out
+
+
+def _ring_core_fwd(q, k, v, axis_name, causal):
+    out, lse = _ring_fwd_scan(q, k, v, axis_name, causal)
+    return out, (q, k, v, out, lse)
+
+
+def _ring_core_bwd(axis_name, causal, res, do):
+    """Reverse ring: KV blocks make a second full rotation; each step
+    recomputes that block's softmax and adds its dk/dv contribution into
+    accumulators that TRAVEL WITH the block — after sp rotations the
+    block (and its finished gradient) is back on its home device. dq
+    accumulates locally. Residuals were O(S_local); so are the carries.
+    """
+    q, k, v, out, lse = res
+    sp = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    B, S, H, D = q.shape
+    Hkv = k.shape[2]
+    group = H // Hkv
+    perm = _ring_perm(sp)
+
+    do_g = do.astype(jnp.float32) \
+        .reshape(B, S, Hkv, group, D).transpose(0, 2, 3, 1, 4)
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1)                        # (B, S, H)
+    delta = delta.reshape(B, S, Hkv, group).transpose(0, 2, 3, 1)
+
+    dq0 = jnp.zeros((B, S, H, D), jnp.float32)
+    dk0 = jnp.zeros(k.shape, jnp.float32)
+    dv0 = jnp.zeros(v.shape, jnp.float32)
+
+    def step(carry, t):
+        k_cur, v_cur, dq_acc, dk_acc, dv_acc = carry
+        src = (idx - t) % sp
+
+        def full_block(kv):
+            return _block_grads(q, kv[0], kv[1], do_g, lse, delta,
+                                "full")
+
+        def diag_block(kv):
+            return _block_grads(q, kv[0], kv[1], do_g, lse, delta,
+                                "causal")
+
+        def skip_block(kv):
+            del kv
+            return dq0, dk0, dv0
+
+        if causal:
+            branch = jnp.where(src == idx, 1,
+                               jnp.where(src < idx, 0, 2))
+            dq_t, dk_t, dv_t = jax.lax.switch(
+                branch, (full_block, diag_block, skip_block),
+                (k_cur, v_cur))
+        else:
+            dq_t, dk_t, dv_t = full_block((k_cur, v_cur))
+
+        dq_acc = dq_acc + dq_t
+        dk_acc = dk_acc + dk_t
+        dv_acc = dv_acc + dv_t
+        # Rotate the KV block together with its gradient accumulators.
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        dk_nxt = jax.lax.ppermute(dk_acc, axis_name, perm)
+        dv_nxt = jax.lax.ppermute(dv_acc, axis_name, perm)
+        return (k_nxt, v_nxt, dq_acc, dk_nxt, dv_nxt), None
+
+    (k_f, v_f, dq, dk, dv), _ = jax.lax.scan(
+        step, (k, v, dq0, dk0, dv0), jnp.arange(sp))
+    del k_f, v_f
+    return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype))
+
+
+_ring_core.defvjp(_ring_core_fwd, _ring_core_bwd)
+
+
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                   axis_name: str = AXIS_SP,
+                   causal: bool = True) -> jax.Array:
+    """Sequence-parallel attention; call INSIDE shard_map.
+
+    Shapes are per-device shards: q/k/v (B, S_local, H|Hkv, D) where the
+    global sequence is the concatenation of shards in ``axis_name``
+    order. Output matches q's shape/dtype.
+    """
+    sp = jax.lax.axis_size(axis_name)
+    B, S, H, D = q.shape
+
+    if sp == 1:
+        o, m, l = _block_attn_with_lse(q, k, v,
+                                       "causal" if causal else "full")
+        out = o / jnp.maximum(l, 1e-30)[..., None]
+        return out.transpose(0, 3, 1, 2, 4).reshape(B, S, H, D) \
+            .astype(q.dtype)
+
+    return _ring_core(q, k, v, axis_name, causal)
 
 
 def make_ring_attention(mesh: Mesh, causal: bool = True,
