@@ -60,7 +60,9 @@ class TransformerConfig:
     # extra Pallas launches and compiles far more slowly.
     remat_policy: str = "selective"  # "full" | "selective"
     attention_impl: str = "auto"
-    pp_microbatches: int = 4      # GPipe microbatches when mesh pp > 1
+    pp_microbatches: int = 4      # microbatches when mesh pp > 1
+    pp_schedule: str = "gpipe"    # "gpipe" | "interleaved"
+    pp_virtual_stages: int = 2    # chunks/device when interleaved
     # MoE (expert-parallel): > 0 turns every MLP into a top-k routed
     # expert layer with a load-balancing aux loss.
     moe_num_experts: int = 0
@@ -91,6 +93,15 @@ class TransformerConfig:
         if not 0.0 <= self.dropout < 1.0:
             raise ValueError(
                 f"dropout must be in [0, 1), got {self.dropout}")
+        if self.moe_num_experts > 0 and self.moe_capacity_factor <= 0:
+            raise ValueError(
+                f"moe_capacity_factor must be > 0, got "
+                f"{self.moe_capacity_factor} (capacity 0 would silently "
+                "drop every token)")
+        if self.pp_schedule not in ("gpipe", "interleaved"):
+            raise ValueError(
+                f"unknown pp_schedule '{self.pp_schedule}' "
+                "(expected 'gpipe' or 'interleaved')")
         if self.moe_impl not in ("routed", "dense"):
             raise ValueError(
                 f"unknown moe_impl '{self.moe_impl}' "
@@ -368,31 +379,37 @@ class Transformer:
 
         pp = self._mesh_axis_sizes().get("pp", 1)
 
-        if dropping:
-            layer_rngs = jax.random.split(
-                jax.random.fold_in(rng, 7), c.n_layers)
+        # Per-layer dropout rngs derive from (global layer id,
+        # microbatch index, data-shard index) so the draws are identical
+        # on every schedule: plain scan uses mb=0/shard=0; the pipeline
+        # threads the tick's microbatch through and folds the batch
+        # shard (inside shard_map each device sees only its batch rows,
+        # so without the shard term every dp/fsdp shard would draw the
+        # SAME mask — correlated dropout across data shards). pp=N with
+        # one microbatch and one data shard draws exactly the masks
+        # pp=1 draws (tested in tests/test_pipeline.py).
+        rng7 = jax.random.fold_in(rng, 7) if dropping else None
 
+        def body_with(mb_idx, shard_idx):
             def body(carry, inp):
-                layer, layer_rng = inp
+                layer, lid = inp
                 x, aux = carry
+                lrng = None
+                if dropping:
+                    lrng = jax.random.fold_in(
+                        jax.random.fold_in(
+                            jax.random.fold_in(rng7, lid), mb_idx),
+                        shard_idx)
                 x, layer_aux = self._block(x, layer, positions,
-                                           dropout_rng=layer_rng)
+                                           dropout_rng=lrng)
                 return (x, aux + layer_aux), None
-            scan_xs = (stacked, layer_rngs)
-        else:
-            def body(carry, layer):
-                x, aux = carry
-                x, layer_aux = self._block(x, layer, positions)
-                return (x, aux + layer_aux), None
-            scan_xs = stacked
+            return body
+
+        layer_ids_all = jnp.arange(c.n_layers, dtype=jnp.int32)
 
         if pp > 1:
-            if dropping:
-                raise NotImplementedError(
-                    "dropout under pipeline parallelism (pp>1) is not "
-                    "wired yet; set dropout=0 or pp=1")
-            # GPipe wavefront over pp stages (parallel/pipeline.py):
-            # each stage scans its local layer shard per microbatch.
+            # Pipeline wavefront over pp stages (parallel/pipeline.py):
+            # each stage scans its local layer chunk per microbatch.
             if c.attention_impl == "ring":
                 raise ValueError(
                     "pipeline (pp>1) + ring attention not composable "
@@ -402,40 +419,49 @@ class Transformer:
             )
             from distributed_training_tpu.runtime import BATCH_AXES
 
-            def stage_body(stage_params, xb):
+            batch_ax = tuple(
+                a for a in BATCH_AXES
+                if self._mesh_axis_sizes().get(a, 1) > 1)
+
+            def stage_body(stage_params, layer_ids, xb, mb_idx):
+                shard_idx = (jax.lax.axis_index(batch_ax) if batch_ax
+                             else jnp.zeros((), jnp.int32))
                 (xb, aux), _ = jax.lax.scan(
-                    body, (xb, jnp.zeros((), jnp.float32)), stage_params)
+                    body_with(mb_idx, shard_idx),
+                    (xb, jnp.zeros((), jnp.float32)),
+                    (stage_params, layer_ids))
                 return xb, aux
 
             # Largest microbatch count <= pp_microbatches such that the
             # per-microbatch batch B/M still splits evenly over the
             # data-sharded mesh axes (shard_map requires it).
-            shards = 1
-            if self.mesh is not None:
-                sizes = dict(zip(self.mesh.axis_names,
-                                 self.mesh.devices.shape))
-                shards = math.prod(sizes.get(a, 1) for a in BATCH_AXES)
+            shards = math.prod(
+                self._mesh_axis_sizes().get(a, 1) for a in BATCH_AXES)
             M = max(m for m in range(1, min(c.pp_microbatches, B) + 1)
                     if B % m == 0 and (B // m) % shards == 0)
             x, aux = pipeline_apply(
                 stage_body, stacked, x, self.mesh,
-                num_microbatches=M, batch_axes=BATCH_AXES)
+                num_microbatches=M, batch_axes=BATCH_AXES,
+                schedule=c.pp_schedule,
+                virtual_stages=c.pp_virtual_stages)
             # aux is an intensive (batch-mean) statistic summed over M
             # microbatches — renormalize so pp meshes optimize the same
             # objective as non-pp meshes.
             aux = aux / M
         else:
-            block = body
+            block = body_with(jnp.zeros((), jnp.int32),
+                              jnp.zeros((), jnp.int32))
             if c.remat:
                 # Values validated in __post_init__; "full" → default
                 # save-nothing policy.
                 policy = (jax.checkpoint_policies.save_only_these_names(
                     "attn_out") if c.remat_policy == "selective"
                     else None)
-                block = jax.checkpoint(body, prevent_cse=False,
+                block = jax.checkpoint(block, prevent_cse=False,
                                        policy=policy)
             (x, aux), _ = jax.lax.scan(
-                block, (x, jnp.zeros((), jnp.float32)), scan_xs)
+                block, (x, jnp.zeros((), jnp.float32)),
+                (stacked, layer_ids_all))
         aux = aux / c.n_layers  # mean load-balancing loss over layers
 
         x = _layer_norm(x, params["final_norm"]["scale"],
@@ -675,10 +701,15 @@ class Transformer:
         return jax.jit(run)(params, prompt, rng)
 
 
-def _moe_router(h: jax.Array, mlp: dict, c: TransformerConfig):
+def _moe_router(h: jax.Array, mlp: dict, c: TransformerConfig,
+                valid: jax.Array | None = None):
     """Shared routing head: normalized top-k weights/indices + the
     Switch/GShard load-balancing aux (E · Σ_e mean_prob_e · mean_frac_e),
-    computed pre-capacity so the balance signal sees dropped tokens."""
+    computed pre-capacity so the balance signal sees dropped tokens.
+
+    ``valid`` (same shape as h minus the feature dim) masks padding
+    rows: they are removed from the assignment one-hots (so they claim
+    no capacity slots) and from the aux statistics."""
     dt = h.dtype
     E, k = c.moe_num_experts, c.moe_top_k
     gates = jnp.einsum("...d,de->...e", h, mlp["router"].astype(dt))
@@ -687,8 +718,15 @@ def _moe_router(h: jax.Array, mlp: dict, c: TransformerConfig):
     topv = topv / jnp.sum(topv, axis=-1, keepdims=True)
     onehot = jax.nn.one_hot(topi, E, dtype=jnp.float32)  # (..., k, E)
     red = tuple(range(probs.ndim - 1))
-    frac = jnp.mean(jnp.sum(onehot, axis=-2), axis=red)      # (E,)
-    mean_prob = jnp.mean(probs, axis=red)                    # (E,)
+    if valid is None:
+        frac = jnp.mean(jnp.sum(onehot, axis=-2), axis=red)  # (E,)
+        mean_prob = jnp.mean(probs, axis=red)                # (E,)
+    else:
+        v = valid.astype(jnp.float32)
+        onehot = onehot * v[..., None, None]
+        n = jnp.maximum(jnp.sum(v), 1.0)
+        frac = jnp.sum(onehot, axis=red + (onehot.ndim - 2,)) / n
+        mean_prob = jnp.sum(probs * v[..., None], axis=red) / n
     aux = E * jnp.sum(frac * mean_prob)
     return topv, onehot, aux
 
@@ -707,12 +745,14 @@ def _moe_mlp_dense(h, mlp, c: TransformerConfig):
     return out, aux
 
 
-def _moe_group_size(T: int, cap: int) -> int:
-    """Largest divisor of T that is <= cap (dispatch-tensor bound)."""
+def _moe_group_size(T: int, cap: int) -> tuple[int, int]:
+    """Group size and padded token count: T pads UP to a multiple of
+    ``min(T, cap)`` rather than shrinking the group to a divisor — a
+    divisor search would collapse to tiny groups for poorly-composite T
+    (e.g. T=2·1031), exploding the per-group capacity overhead. Pad
+    rows are masked out of routing entirely."""
     g = min(T, max(1, cap))
-    while T % g:
-        g -= 1
-    return g
+    return g, -(-T // g) * g
 
 
 def _moe_mlp_routed(h, mlp, c: TransformerConfig):
@@ -735,13 +775,19 @@ def _moe_mlp_routed(h, mlp, c: TransformerConfig):
     E, k = c.moe_num_experts, c.moe_top_k
     B, S, D = h.shape
     T = B * S
-    g = _moe_group_size(T, c.moe_group_size)
-    G = T // g
+    g, T_pad = _moe_group_size(T, c.moe_group_size)
+    G = T_pad // g
     C = int(-(-c.moe_capacity_factor * k * g // E))  # ceil
     C = min(C, g * k)  # can't hold more than every (token, slot)
 
-    x = h.reshape(G, g, D)
-    topv, onehot, aux = _moe_router(x, mlp, c)
+    x = h.reshape(T, D)
+    valid = None
+    if T_pad != T:
+        x = jnp.concatenate(
+            [x, jnp.zeros((T_pad - T, D), x.dtype)], axis=0)
+        valid = (jnp.arange(T_pad) < T).reshape(G, g)
+    x = x.reshape(G, g, D)
+    topv, onehot, aux = _moe_router(x, mlp, c, valid=valid)
     # (G, g, k, E) -> slot-major (G, k·g, E): all slot-0 rows first, so
     # the running count gives slot 0 strictly higher buffer priority.
     oh = onehot.transpose(0, 2, 1, 3).reshape(G, k * g, E)
@@ -761,7 +807,7 @@ def _moe_mlp_routed(h, mlp, c: TransformerConfig):
     up = jax.nn.gelu(up)
     down = jnp.einsum("gecf,efd->gecd", up, mlp["wo"].astype(dt))
     out = jnp.einsum("gsec,gecd->gsd", combine.astype(dt), down)
-    return out.reshape(B, S, D), aux
+    return out.reshape(T_pad, D)[:T].reshape(B, S, D), aux
 
 
 def _moe_mlp(h: jax.Array, mlp: dict, c: TransformerConfig

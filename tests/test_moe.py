@@ -37,12 +37,28 @@ def _mlp_params(c, seed=0):
     }
 
 
-def test_group_size_divides():
-    assert _moe_group_size(1024, 1024) == 1024
-    assert _moe_group_size(2048, 1024) == 1024
-    assert _moe_group_size(992, 1024) == 992
-    assert _moe_group_size(992, 500) == 496
-    assert _moe_group_size(7, 4) == 1
+def test_group_size_pads_up():
+    """Group size never collapses for poorly-composite T; T pads up."""
+    assert _moe_group_size(1024, 1024) == (1024, 1024)
+    assert _moe_group_size(2048, 1024) == (1024, 2048)
+    assert _moe_group_size(992, 1024) == (992, 992)
+    assert _moe_group_size(992, 500) == (500, 1000)
+    assert _moe_group_size(7, 4) == (4, 8)
+    assert _moe_group_size(2 * 1031, 1024) == (1024, 3072)
+
+
+def test_routed_ragged_tokens_match_dense():
+    """T not divisible by the group cap: pad rows must claim no
+    capacity and contribute nothing (output still matches dense)."""
+    c = _cfg(moe_top_k=2, moe_capacity_factor=4.0, moe_group_size=5)
+    mlp = _mlp_params(c)
+    h = jnp.asarray(
+        np.random.default_rng(4).standard_normal((1, 13, 32)),
+        jnp.float32)  # T=13, g=5 -> pads to 15
+    out_r, aux_r = _moe_mlp_routed(h, mlp, c)
+    out_d, aux_d = _moe_mlp_dense(h, mlp, c)
+    np.testing.assert_allclose(out_r, out_d, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(aux_r, aux_d, rtol=1e-5, atol=0)
 
 
 @pytest.mark.parametrize("top_k", [1, 2])
