@@ -26,7 +26,7 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 SEQ_LEN = 1024
-BATCH = int(os.environ.get("DTT_BENCH_BATCH", "32"))
+_BATCH_ENV = os.environ.get("DTT_BENCH_BATCH", "32")
 WARMUP_STEPS = 3
 TIMED_STEPS = 20
 PROBE_TIMEOUT_S = int(os.environ.get("DTT_BENCH_PROBE_TIMEOUT", "120"))
@@ -182,11 +182,40 @@ def measure(batch_size: int, seq_len: int = SEQ_LEN,
     }
 
 
+def _resolve_batch() -> int:
+    """DTT_BENCH_BATCH: an int, or 'auto' = largest power-of-two batch
+    whose estimated footprint fits the local chip's HBM
+    (utils/memory.py — VERDICT r2 item 1a)."""
+    if _BATCH_ENV != "auto":
+        return int(_BATCH_ENV)
+    import jax
+
+    from distributed_training_tpu.models.transformer import (
+        PRESETS, TransformerConfig)
+    from distributed_training_tpu.utils.memory import (
+        HBM_GIB, estimate_transformer_memory)
+    kind = jax.devices()[0].device_kind.lower()
+    if not any(k in kind for k in HBM_GIB):
+        return 8
+    key = next(k for k in HBM_GIB if k in kind)
+    cfg = TransformerConfig(dtype="bfloat16",
+                            **PRESETS["gpt2_125m"])
+    batch = 8
+    while batch < 512:
+        est = estimate_transformer_memory(
+            cfg, batch_per_chip=2 * batch, seq_len=SEQ_LEN)
+        if not est.fits(key):
+            break
+        batch *= 2
+    _phase("auto_batch", batch=batch)
+    return batch
+
+
 def main() -> None:
     probe_backend()
     watchdog = _arm_watchdog()
     try:
-        m = measure(BATCH)
+        m = measure(_resolve_batch())
     except Exception as e:  # noqa: BLE001 — evidence line must survive
         _fail("measure", f"{type(e).__name__}: {e}")
         return

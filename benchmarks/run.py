@@ -108,6 +108,27 @@ CONFIGS: dict = {
         }),
         "sample_unit": "tokens",
     },
+    "bytes_lm_real": {
+        "desc": "byte-level LM on REAL text (this repo's source/docs "
+                "prepared into a uint8 memmap shard via data/prepare.py "
+                "— the hermetic real-data path; BASELINE config 3's "
+                "real-corpus analogue)",
+        "model": ("gpt2_125m", {"vocab_size": 256, "d_model": 512,
+                                "n_layers": 8, "n_heads": 8,
+                                "max_seq_len": 512}),
+        "seq_len": 512,
+        "prepare_bytes": True,  # build the corpus shard if missing
+        "overrides": _base({
+            "train.batch_size": 16,
+            "train.dataset": "bytes",
+            "train.dataset_kwargs": {"path": "", "seq_len": 512},
+            "train.optimizer": "adamw",
+            "train.learning_rate": 6e-4,
+            "train.parallel_strategy": "ddp",
+            "train.dtype": "bfloat16",
+        }),
+        "sample_unit": "tokens",
+    },
     "tf7b_fsdp": {
         "desc": "7B-class transformer, FSDP + remat + bf16 "
                 "(BASELINE config 5)",
@@ -157,6 +178,24 @@ def run_config(name: str, steps: int, warmup: int,
     cfg = override_config(Config(), **groups)
     if spec.get("device"):
         cfg.train.device = spec["device"]
+
+    if spec.get("prepare_bytes"):
+        # Real-text shard: rebuilt each run (sub-second) from this
+        # repo's own source/docs — deterministic, hermetic, never
+        # stale, and repo-local (a fixed world-readable /tmp name
+        # could be pre-created by another user).
+        from distributed_training_tpu.data.prepare import prepare_bytes
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(
+            __file__)))
+        shard = os.path.join(repo, "benchmarks", "_build",
+                             "bench_corpus.bin")
+        prepare_bytes(shard, [
+            os.path.join(repo, "distributed_training_tpu",
+                         "**", "*.py"),
+            os.path.join(repo, "docs", "*.md"),
+            os.path.join(repo, "*.md"),
+        ])
+        cfg.train.dataset_kwargs["path"] = shard
 
     rt = initialize_runtime(cfg)
     model_name, model_kwargs = spec["model"]
@@ -238,9 +277,29 @@ def main(argv=None) -> int:
     names = sorted(CONFIGS) if args.all else [args.config]
     if names == [None]:
         p.error("pass --config NAME or --all")
-    results = [run_config(n, args.steps, args.warmup, args.full_size)
-               for n in names]
-    payload = results[0] if len(results) == 1 else results
+    if len(names) > 1:
+        # One subprocess per config: a shared process would leak each
+        # config's compilation cache / device allocations into the
+        # next measurement (and mlp_cpu's cpu-device selection would
+        # poison later TPU configs' backend choice).
+        import subprocess
+        results = []
+        for n in names:
+            cmd = [sys.executable, os.path.abspath(__file__),
+                   "--config", n, "--steps", str(args.steps),
+                   "--warmup", str(args.warmup)]
+            if args.full_size:
+                cmd.append("--full-size")
+            proc = subprocess.run(cmd, capture_output=True, text=True)
+            if proc.returncode != 0:
+                results.append({"config": n, "error":
+                                proc.stderr.strip()[-300:]})
+            else:
+                results.append(json.loads(proc.stdout))
+        payload = results
+    else:
+        payload = run_config(names[0], args.steps, args.warmup,
+                             args.full_size)
     text = json.dumps(payload, indent=2)
     print(text)
     if args.out:

@@ -1,5 +1,9 @@
-"""Checkpointing subsystem (Orbax-backed)."""
+"""Checkpointing subsystem (Orbax-backed + consolidated export)."""
 
+from distributed_training_tpu.checkpoint.consolidate import (  # noqa: F401
+    export_consolidated,
+    load_consolidated,
+)
 from distributed_training_tpu.checkpoint.manager import (  # noqa: F401
     Checkpointer,
 )

@@ -1,0 +1,87 @@
+"""Gathered single-artifact checkpoint export (FULL_STATE_DICT analogue).
+
+The day-to-day checkpoint path is sharded Orbax (manager.py) — scalable
+and topology-tolerant. What it doesn't give you is ONE portable file to
+hand to an inference stack or archive. The reference's FSDP strategy had
+exactly this export (FULL_STATE_DICT gather with rank0-only write,
+/root/reference/src/dist_strategy/fsdp_strategy.py:31-36) — and hung,
+because only rank 0 entered the collective (SURVEY.md §8 B6).
+
+Here the contract is explicit: ``export_consolidated`` is COLLECTIVE —
+every process calls it (the gather is an all-gather over the mesh),
+process 0 alone writes, and everyone leaves together. The artifact is a
+single msgpack file of the pure nested-dict state (flax serialization),
+loadable anywhere — no mesh, no sharding metadata, no orbax layout.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import tempfile
+from typing import Any
+
+import jax
+import numpy as np
+from flax import serialization
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+logger = logging.getLogger(__name__)
+
+
+def gather_full_state(state: Any, mesh: Mesh) -> Any:
+    """Gather every leaf to a fully-replicated host copy.
+
+    COLLECTIVE: every process must call (device_put to the replicated
+    sharding is an all-gather across the mesh). Returns a NumPy pytree.
+    """
+    replicated = NamedSharding(mesh, P())
+
+    def to_host(x: Any) -> np.ndarray:
+        if isinstance(x, jax.Array) and not x.is_fully_replicated:
+            x = jax.device_put(x, replicated)
+        return np.asarray(x)
+
+    return jax.tree.map(to_host, state)
+
+
+def export_consolidated(path: str, state: Any, mesh: Mesh,
+                        meta: dict | None = None) -> str:
+    """Write the full (gathered) state as ONE portable msgpack file.
+
+    COLLECTIVE: call from every process; process 0 writes (atomically:
+    temp file + rename), all processes synchronize before returning so
+    no process races ahead of the durable artifact.
+    """
+    full = gather_full_state(state, mesh)
+    payload = {
+        "state": serialization.to_state_dict(full),
+        "meta": dict(meta or {}),
+    }
+    if jax.process_index() == 0:
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        blob = serialization.msgpack_serialize(payload)
+        fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path) or ".",
+                                   suffix=".tmp")
+        try:
+            with os.fdopen(fd, "wb") as f:
+                f.write(blob)
+            os.replace(tmp, path)
+        finally:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+        logger.info("consolidated checkpoint exported: %s (%d bytes)",
+                    path, len(blob))
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices("consolidated_export")
+    return path
+
+
+def load_consolidated(path: str) -> tuple[Any, dict]:
+    """Read a consolidated artifact back as (state_dict pytree of NumPy
+    arrays, meta). Host-local — no mesh needed; shard the result onto
+    any topology with ``jax.device_put`` / ``from_state_dict``."""
+    with open(path, "rb") as f:
+        payload = serialization.msgpack_restore(f.read())
+    return payload["state"], dict(payload.get("meta") or {})

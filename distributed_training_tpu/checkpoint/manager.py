@@ -33,7 +33,7 @@ class Checkpointer:
     """Thin lifecycle wrapper over ``ocp.CheckpointManager``."""
 
     def __init__(self, directory: str, max_to_keep: int = 3,
-                 async_save: bool = True):
+                 async_save: bool = True) -> None:
         self.directory = directory
         options = ocp.CheckpointManagerOptions(
             max_to_keep=max_to_keep,

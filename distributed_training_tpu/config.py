@@ -44,6 +44,9 @@ class TrainConfig:
     total_epochs: int = 10
     save_every: int = 2           # epochs between checkpoints
     snapshot_path: str = "checkpoints"  # absolute-anchored at load (fixes B2)
+    # Also export a gathered single-file artifact at every save point
+    # (the reference FSDP FULL_STATE_DICT analogue; consolidate.py).
+    gather_on_save: bool = False
     dataset_size: int = 2048
     learning_rate: float = 1e-3
     device: str = "auto"          # "auto" | "tpu" | "cpu"

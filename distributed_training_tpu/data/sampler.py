@@ -30,7 +30,7 @@ class DistributedShardSampler:
 
     def __init__(self, dataset_size: int, num_shards: int,
                  shuffle: bool = True, seed: int = 0,
-                 drop_last: bool = False):
+                 drop_last: bool = False) -> None:
         if dataset_size <= 0:
             raise ValueError(f"dataset_size must be > 0, got {dataset_size}")
         if num_shards <= 0:

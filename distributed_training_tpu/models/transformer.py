@@ -648,6 +648,14 @@ class Transformer:
         if temperature > 0 and rng is None:
             raise ValueError("sampling (temperature > 0) needs an rng")
         rng = rng if rng is not None else jax.random.PRNGKey(0)
+        # The compiled loop is cached per trace signature — a bare
+        # jax.jit(run) here would retrace and recompile on EVERY call.
+        cache_key = (P, max_new_tokens, temperature, top_k, max_len)
+        if not hasattr(self, "_generate_cache"):
+            self._generate_cache: dict = {}
+        cached = self._generate_cache.get(cache_key)
+        if cached is not None:
+            return cached(params, prompt, rng)
         stacked_keys = ("ln1", "ln2", "attn", "mlp")
 
         def sample(logits, key):
@@ -698,7 +706,9 @@ class Transformer:
                     [tok0[:, None], rest.T.astype(jnp.int32)], axis=1)
             return tok0[:, None]
 
-        return jax.jit(run)(params, prompt, rng)
+        fn = jax.jit(run)
+        self._generate_cache[cache_key] = fn
+        return fn(params, prompt, rng)
 
 
 def _moe_router(h: jax.Array, mlp: dict, c: TransformerConfig,

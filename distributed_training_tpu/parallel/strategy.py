@@ -130,10 +130,13 @@ class ShardingStrategy(ABC):
     min_shard_elems: int = 2 ** 12
 
     name: str = dataclasses.field(default="base", init=False)
-    # True → checkpoint restore must gather to a full (replicated) state
-    # on save. All modern paths save sharded; kept for parity with the
-    # reference FSDP FULL_STATE_DICT gather (fsdp_strategy.py:31-36).
-    gather_on_save: bool = dataclasses.field(default=False, init=False)
+    # True → each save point ALSO exports a gathered single-file
+    # artifact (checkpoint/consolidate.py) next to the sharded Orbax
+    # checkpoint — the working analogue of the reference FSDP
+    # FULL_STATE_DICT gather (fsdp_strategy.py:31-36), minus its
+    # rank0-only-collective deadlock (SURVEY.md §8 B6). The sharded
+    # path stays primary (the gather is O(model) HBM + host RAM).
+    gather_on_save: bool = False
 
     @abstractmethod
     def param_spec(self, shape: tuple[int, ...],
@@ -177,10 +180,11 @@ class DataParallel(ShardingStrategy):
     gradient all-reduce over ICI in the backward pass.
     """
 
-    def __post_init__(self):
+    def __post_init__(self) -> None:
         self.name = "ddp"
 
-    def param_spec(self, shape, logical) -> P:
+    def param_spec(self, shape: tuple[int, ...],
+                   logical: tuple[str | None, ...] | None) -> P:
         del shape, logical
         return P()  # fully replicated
 
@@ -208,10 +212,11 @@ class FullyShardedDataParallel(ShardingStrategy):
         "expert": AXIS_FSDP,
     })
 
-    def __post_init__(self):
+    def __post_init__(self) -> None:
         self.name = "fsdp"
 
-    def param_spec(self, shape, logical) -> P:
+    def param_spec(self, shape: tuple[int, ...],
+                   logical: tuple[str | None, ...] | None) -> P:
         sizes = {AXIS_FSDP: self.fsdp_size}
         if logical is not None:
             spec = prune_spec(shape, logical_to_spec(logical, self.rules),
@@ -250,10 +255,11 @@ class TensorParallel(ShardingStrategy):
         "expert": AXIS_FSDP,
     })
 
-    def __post_init__(self):
+    def __post_init__(self) -> None:
         self.name = "tp"
 
-    def param_spec(self, shape, logical) -> P:
+    def param_spec(self, shape: tuple[int, ...],
+                   logical: tuple[str | None, ...] | None) -> P:
         sizes = {AXIS_FSDP: self.fsdp_size, AXIS_TP: self.tp_size}
         if logical is not None:
             return prune_spec(shape, logical_to_spec(logical, self.rules),

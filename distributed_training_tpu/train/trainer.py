@@ -167,7 +167,8 @@ class Trainer:
         from distributed_training_tpu.parallel import get_strategy
         self.strategy: ShardingStrategy = get_strategy(
             tcfg.parallel_strategy, runtime.spec,
-            min_shard_elems=tcfg.min_shard_elems)
+            min_shard_elems=tcfg.min_shard_elems,
+            gather_on_save=tcfg.gather_on_save)
         if hasattr(model, "bind_mesh"):
             model.bind_mesh(runtime.mesh)
 
@@ -353,6 +354,8 @@ class Trainer:
                     self.global_step, self.state,
                     meta={"epoch": epoch if not preempted else epoch - 1},
                     force=preempted)
+                if self.strategy.gather_on_save:
+                    self.export_consolidated(epoch=epoch)
             if preempted:
                 logger.warning("stopping at epoch %d due to preemption",
                                epoch)
@@ -362,6 +365,26 @@ class Trainer:
             self.checkpointer.wait()
         summary["wall_time_s"] = time.perf_counter() - t0
         return summary
+
+    # -- consolidated export -----------------------------------------------
+
+    def export_consolidated(self, epoch: int | None = None,
+                            path: str | None = None) -> str:
+        """Gather the full train state and write ONE portable artifact
+        (the reference's FSDP FULL_STATE_DICT gather, done collectively
+        so it cannot deadlock — every process enters; process 0 writes).
+        Default path: <snapshot_path>/consolidated_step<N>.msgpack."""
+        from distributed_training_tpu.checkpoint import consolidate
+        if path is None:
+            import os
+            path = os.path.join(
+                self.cfg.train.snapshot_path,
+                f"consolidated_step{self.global_step}.msgpack")
+        meta = {"step": self.global_step}
+        if epoch is not None:
+            meta["epoch"] = epoch
+        return consolidate.export_consolidated(
+            path, self.state, self.rt.mesh, meta=meta)
 
     # -- eval --------------------------------------------------------------
 

@@ -72,7 +72,10 @@ def estimate_transformer_memory(
                        ≈ 2·D · B·S · bytes (carry + saved input)
         remat selective: residual + saved attention output
                        ≈ 3·D · B·S · bytes
-      plus the logits buffer B·S·V fp32 (often the true peak).
+      plus the loss head: with ``loss_impl='dense'`` the B·S·V fp32
+      logits buffer (often the true peak); with the default fused
+      chunked xent (ops/xent.py) only a chunk_rows·V fp32 tile plus the
+      per-token lse is ever alive.
     These are planning numbers, not allocator ground truth — XLA
     fusion/padding moves them ±20%.
     """
@@ -107,7 +110,13 @@ def estimate_transformer_memory(
     else:  # full
         act_per_layer = 2 * D * B * S * ab
     acts_b = c.n_layers * act_per_layer
-    acts_b += B * S * c.vocab_size * 4 / max(1, tp)  # fp32 logits
+    if getattr(c, "loss_impl", "fused") == "dense":
+        # fp32 logits + their softmax residual dominate.
+        acts_b += B * S * c.vocab_size * 4 / max(1, tp)
+    else:
+        from distributed_training_tpu.ops.xent import DEFAULT_CHUNK_ROWS
+        acts_b += DEFAULT_CHUNK_ROWS * c.vocab_size * 4  # live tile
+        acts_b += B * S * (4 + D * ab)  # lse + saved hidden states
 
     gib = 1 / (1024 ** 3)
     return MemoryEstimate(

@@ -1,0 +1,87 @@
+"""Real-text end-to-end: prepare a byte shard from actual files, train
+a byte LM on it, and see held-out loss fall (VERDICT round-2 item 9 —
+all previous loss curves were synthetic-token)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+
+from distributed_training_tpu.config import Config
+from distributed_training_tpu.data import ShardedDataLoader
+from distributed_training_tpu.data.datasets import (build_dataset,
+                                                    train_eval_split)
+from distributed_training_tpu.data.prepare import prepare_bytes
+from distributed_training_tpu.models import build_model
+from distributed_training_tpu.train.trainer import Trainer
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_prepare_bytes_roundtrip(tmp_path):
+    src = tmp_path / "a.txt"
+    src.write_text("hello tpu world")
+    src2 = tmp_path / "b.txt"
+    src2.write_text("ring attention")
+    out = str(tmp_path / "corpus.bin")
+    meta = prepare_bytes(out, [str(src), str(src2)])
+    blob = open(out, "rb").read()
+    assert blob == b"hello tpu world\n\nring attention"
+    assert meta["n_tokens"] == len(blob)
+    assert meta["vocab_size"] == 256
+    side = json.load(open(out + ".json"))
+    assert side["sha256"] == meta["sha256"]
+
+
+def test_prepare_cli(tmp_path):
+    (tmp_path / "x.txt").write_text("some real text " * 10)
+    out = str(tmp_path / "c.bin")
+    proc = subprocess.run(
+        [sys.executable, "-m", "distributed_training_tpu.data.prepare",
+         "--out", out, str(tmp_path / "*.txt")],
+        capture_output=True, text=True, cwd=REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stderr
+    meta = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert meta["n_tokens"] == os.path.getsize(out)
+
+
+def test_byte_lm_trains_on_real_text(cpu8, tmp_path):
+    """Train a tiny byte LM on this repo's own documentation; held-out
+    val loss must fall from its untrained level (real-data evidence,
+    not synthetic tokens)."""
+    shard = str(tmp_path / "corpus.bin")
+    prepare_bytes(shard, [os.path.join(REPO, "*.md"),
+                          os.path.join(REPO, "docs", "*.md")])
+    assert os.path.getsize(shard) > 50_000  # real corpus, not a stub
+
+    cfg = Config()
+    cfg.train.batch_size = 4
+    cfg.train.total_epochs = 2
+    cfg.train.log_every = 0
+    cfg.train.learning_rate = 1e-3
+    cfg.train.optimizer = "adamw"
+    cfg.train.parallel_strategy = "ddp"
+    cfg.train.eval_every = 1
+
+    ds = build_dataset("bytes", path=shard, seq_len=64)
+    train_ds, eval_ds = train_eval_split(
+        ds, 0.1, seed=0, multiple_of=4 * cpu8.data_shard_count)
+    model = build_model("transformer", vocab_size=256, d_model=64,
+                        n_layers=2, n_heads=4, max_seq_len=64,
+                        dtype="float32")
+    loader = ShardedDataLoader(train_ds, cpu8, batch_size=4,
+                               shuffle=True, seed=0)
+    eval_loader = ShardedDataLoader(eval_ds, cpu8, batch_size=4,
+                                    shuffle=False)
+    trainer = Trainer(cfg, cpu8, model, loader,
+                      eval_loader=eval_loader)
+    before = trainer.evaluate(eval_loader.epoch(0))
+    assert np.isfinite(before) and before > 4.0  # ~ln(256) untrained
+    summary = trainer.train()
+    after = summary["val_loss"]
+    # Real text has heavy byte-level structure; even 2 tiny epochs cut
+    # loss far below the uniform-byte level.
+    assert after < before - 1.0, (before, after)
