@@ -200,13 +200,16 @@ def _resolve_batch() -> int:
     key = next(k for k in HBM_GIB if k in kind)
     cfg = TransformerConfig(dtype="bfloat16",
                             **PRESETS["gpt2_125m"])
-    batch = 8
-    while batch < 512:
-        est = estimate_transformer_memory(
-            cfg, batch_per_chip=2 * batch, seq_len=SEQ_LEN)
-        if not est.fits(key):
+    batch = 8  # floor — smallest batch the bench will attempt
+    for cand in (8, 16, 32, 64, 128, 256, 512):
+        if estimate_transformer_memory(
+                cfg, batch_per_chip=cand, seq_len=SEQ_LEN).fits(key):
+            batch = cand
+        else:
             break
-        batch *= 2
+    if batch == 8 and not estimate_transformer_memory(
+            cfg, batch_per_chip=8, seq_len=SEQ_LEN).fits(key):
+        _phase("auto_batch_floor_may_not_fit", batch=batch)
     _phase("auto_batch", batch=batch)
     return batch
 

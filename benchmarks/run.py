@@ -284,18 +284,33 @@ def main(argv=None) -> int:
         # poison later TPU configs' backend choice).
         import subprocess
         results = []
+        timeout_s = int(os.environ.get("DTT_BENCH_CONFIG_TIMEOUT",
+                                       "1800"))
         for n in names:
             cmd = [sys.executable, os.path.abspath(__file__),
                    "--config", n, "--steps", str(args.steps),
                    "--warmup", str(args.warmup)]
             if args.full_size:
                 cmd.append("--full-size")
-            proc = subprocess.run(cmd, capture_output=True, text=True)
+            try:
+                proc = subprocess.run(cmd, capture_output=True,
+                                      text=True, timeout=timeout_s)
+            except subprocess.TimeoutExpired:
+                # One hung config (e.g. wedged backend init) must not
+                # hang the suite or discard completed results.
+                results.append({"config": n, "error":
+                                f"timeout after {timeout_s}s"})
+                continue
             if proc.returncode != 0:
                 results.append({"config": n, "error":
                                 proc.stderr.strip()[-300:]})
-            else:
+                continue
+            try:
                 results.append(json.loads(proc.stdout))
+            except ValueError:
+                results.append({"config": n, "error":
+                                "non-JSON child output: "
+                                + proc.stdout.strip()[-200:]})
         payload = results
     else:
         payload = run_config(names[0], args.steps, args.warmup,

@@ -47,6 +47,13 @@ class TrainConfig:
     # Also export a gathered single-file artifact at every save point
     # (the reference FSDP FULL_STATE_DICT analogue; consolidate.py).
     gather_on_save: bool = False
+    # Keep optimizer moments resident in pinned host memory BETWEEN
+    # steps (streamed to device around each compiled step) — the
+    # analogue of the reference FSDP's CPU offload (fsdp_strategy.py:
+    # 23-25). Note: step-peak HBM is unchanged (the moments visit the
+    # device for the update); this frees between-step residency, at
+    # the cost of two opt-state transfers per step.
+    offload_opt_state: bool = False
     dataset_size: int = 2048
     learning_rate: float = 1e-3
     device: str = "auto"          # "auto" | "tpu" | "cpu"

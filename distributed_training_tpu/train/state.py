@@ -54,9 +54,57 @@ def state_specs(strategy: ShardingStrategy,
     return {"params": param_specs, "opt_state": opt_specs, "step": P()}
 
 
-def state_shardings(mesh: Mesh, specs: dict) -> dict:
-    return jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
-                        is_leaf=lambda x: isinstance(x, P))
+def state_shardings(mesh: Mesh, specs: dict,
+                    offload_opt_state: bool = False,
+                    opt_shapes: Any = None) -> dict:
+    """NamedShardings for the state tree.
+
+    ``offload_opt_state=True`` makes ``pinned_host`` memory the
+    RESIDENCY of the optimizer moments — the analogue of the reference
+    FSDP's CPU offload (fsdp_strategy.py:23-25, which was unreachable
+    there, SURVEY.md §8 B7, and which likewise round-trips state to
+    the accelerator per use). The trainer streams the moments to
+    device around each step and back (see Trainer.train_step), so
+    between steps HBM holds params + activations only — AdamW's
+    2×params fp32, the bulk of big-model residency, lives in host RAM.
+    In-jit streaming via memory-space annotations (tiles resident
+    only) is the upgrade path once XLA's host-offload annotations are
+    reliable on the deployed runtime. Requires host-memory support
+    (``supports_memory_kind``); raises otherwise rather than silently
+    keeping state on device."""
+    shardings = jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
+                             is_leaf=lambda x: isinstance(x, P))
+    if offload_opt_state:
+        if not supports_memory_kind(mesh, "pinned_host"):
+            raise ValueError(
+                "offload_opt_state=true but this runtime has no "
+                "pinned_host memory space (CPU test meshes and old "
+                "libtpu builds lack it)")
+        if opt_shapes is None:
+            raise ValueError(
+                "offload_opt_state=true requires opt_shapes (scalar "
+                "step counters must stay on device)")
+
+        def offload(sh: NamedSharding, leaf) -> NamedSharding:
+            # Only array-sized leaves move to host: scalar counters
+            # (Adam's count) trip XLA's side-effecting placement
+            # custom-call under SPMD, and offloading them buys nothing.
+            if getattr(leaf, "ndim", 0) >= 1 and np.prod(leaf.shape) > 1:
+                return sh.with_memory_kind("pinned_host")
+            return sh
+
+        shardings["opt_state"] = jax.tree.map(
+            offload, shardings["opt_state"], opt_shapes)
+    return shardings
+
+
+def supports_memory_kind(mesh: Mesh, kind: str) -> bool:
+    """Whether the mesh's devices expose the given memory space."""
+    try:
+        dev = mesh.devices.reshape(-1)[0]
+        return any(m.kind == kind for m in dev.addressable_memories())
+    except (AttributeError, RuntimeError, jax.errors.JaxRuntimeError):
+        return False
 
 
 def init_state(model, optimizer, rng: jax.Array, shardings: dict) -> dict:

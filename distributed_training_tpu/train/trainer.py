@@ -185,7 +185,23 @@ class Trainer:
         self.state_shardings = state_lib.state_shardings(
             runtime.mesh,
             state_lib.state_specs(self.strategy, self.optimizer,
-                                  param_shapes, logical))
+                                  param_shapes, logical),
+            offload_opt_state=tcfg.offload_opt_state,
+            opt_shapes=(jax.eval_shape(self.optimizer.init, param_shapes)
+                        if tcfg.offload_opt_state else None))
+        # Offload: the compiled step is pure device compute; the
+        # trainer streams opt-state host<->device around it. The
+        # device-residency variant of the sharding tree drives the jit.
+        self._offload = tcfg.offload_opt_state
+        self._device_state_shardings = self.state_shardings
+        if self._offload:
+            self._device_state_shardings = dict(
+                self.state_shardings,
+                opt_state=jax.tree.map(
+                    lambda sh: (sh.with_memory_kind("device")
+                                if sh.memory_kind == "pinned_host"
+                                else sh),
+                    self.state_shardings["opt_state"]))
         self.batch_sharding = NamedSharding(runtime.mesh,
                                             self.strategy.batch_spec())
 
@@ -197,18 +213,21 @@ class Trainer:
                     runtime.mesh,
                     P(None, *self.strategy.batch_spec()))),
             donate_argnums=(0,),
-            out_shardings=(self.state_shardings,
+            out_shardings=(self._device_state_shardings,
                            NamedSharding(runtime.mesh, P())),
         )
 
         # Resume-if-exists (parity: ModelCheckpoint.load on startup,
         # src/distributed_trainer.py:157,97-105) — but restoring optimizer
         # state and step too, which the reference dropped (§5.4).
+        # Init/restore target the device layout; offloaded state moves
+        # to its host residency right after.
         self.epochs_run = 0
         restored = None
         if checkpointer is not None:
             abstract = state_lib.abstract_state(
-                model, self.optimizer, self.init_rng, self.state_shardings)
+                model, self.optimizer, self.init_rng,
+                self._device_state_shardings)
             restored = checkpointer.restore_latest(abstract)
         if restored is not None:
             self.state, meta = restored
@@ -217,9 +236,13 @@ class Trainer:
                         self.epochs_run, int(self.state["step"]))
         else:
             self.state = state_lib.init_state(
-                model, self.optimizer, self.init_rng, self.state_shardings)
+                model, self.optimizer, self.init_rng,
+                self._device_state_shardings)
             logger.info("initialized fresh state: %d params",
                         count_params(self.state["params"]))
+        if self._offload:
+            self.state["opt_state"] = jax.device_put(
+                self.state["opt_state"], self.state_shardings["opt_state"])
         # Host-side mirror of state["step"]: reading the device scalar
         # every step would force a host-device sync per step and defeat
         # async dispatch + prefetch.
@@ -295,8 +318,20 @@ class Trainer:
     # -- loops -------------------------------------------------------------
 
     def train_step(self, batch) -> Mapping[str, jax.Array]:
+        if self._offload:
+            # Stream the moments host->device for the compiled step and
+            # back to their pinned-host residency after — the torch-
+            # FSDP-offload semantic (state lives on host, visits the
+            # accelerator per step). Transfers are async dispatches.
+            self.state["opt_state"] = jax.device_put(
+                self.state["opt_state"],
+                self._device_state_shardings["opt_state"])
         self.state, metrics = self._step_fn(self.state, batch,
                                             self.step_rng)
+        if self._offload:
+            self.state["opt_state"] = jax.device_put(
+                self.state["opt_state"],
+                self.state_shardings["opt_state"])
         self.global_step += 1
         return metrics
 
@@ -350,12 +385,15 @@ class Trainer:
                 # reference's rank-0-only FSDP save hang, SURVEY.md §8 B6).
                 # On preemption: save whatever we have, mid-epoch
                 # included (resume re-runs the interrupted epoch).
+                meta_epoch = epoch if not preempted else epoch - 1
                 self.checkpointer.save(
                     self.global_step, self.state,
-                    meta={"epoch": epoch if not preempted else epoch - 1},
-                    force=preempted)
+                    meta={"epoch": meta_epoch}, force=preempted)
                 if self.strategy.gather_on_save:
-                    self.export_consolidated(epoch=epoch)
+                    # Same epoch label as the sharded checkpoint: an
+                    # interrupted epoch must not read as complete in
+                    # the portable artifact either.
+                    self.export_consolidated(epoch=meta_epoch)
             if preempted:
                 logger.warning("stopping at epoch %d due to preemption",
                                epoch)

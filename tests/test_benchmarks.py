@@ -16,10 +16,14 @@ import run as bench_run  # noqa: E402
 
 
 def test_config_inventory_matches_baseline():
-    """One harness config per BASELINE.json entry."""
+    """One harness config per BASELINE.json entry, plus the real-text
+    byte-LM extension (bytes_lm_real — BASELINE config 3's real-corpus
+    analogue)."""
     with open(os.path.join(REPO, "BASELINE.json")) as f:
         n_baseline = len(json.load(f)["configs"])
-    assert len(bench_run.CONFIGS) == n_baseline == 5
+    assert n_baseline == 5
+    extensions = {"bytes_lm_real"}
+    assert len(set(bench_run.CONFIGS) - extensions) == n_baseline
 
 
 def test_mlp_cpu_end_to_end():

@@ -1,0 +1,68 @@
+"""Optimizer-state host offload (the reference FSDP CPU-offload
+analogue, done the TPU way: pinned_host memory space on the moments)."""
+
+import jax
+import numpy as np
+import pytest
+
+from distributed_training_tpu.config import Config
+from distributed_training_tpu.data import (ShardedDataLoader,
+                                           SyntheticRegressionDataset)
+from distributed_training_tpu.models.mlp import MLP
+from distributed_training_tpu.train import state as state_lib
+from distributed_training_tpu.train.trainer import Trainer
+
+
+def _trainer(rt, offload: bool):
+    cfg = Config()
+    cfg.train.batch_size = 4
+    cfg.train.total_epochs = 1
+    cfg.train.log_every = 0
+    cfg.train.learning_rate = 0.05
+    cfg.train.optimizer = "adamw"
+    cfg.train.parallel_strategy = "fsdp"
+    cfg.train.min_shard_elems = 1
+    cfg.train.offload_opt_state = offload
+    ds = SyntheticRegressionDataset(size=32, seed=0, kind="linear")
+    loader = ShardedDataLoader(ds, rt, batch_size=4, shuffle=False)
+    model = MLP(input_size=20, output_size=1, hidden_sizes=(64,))
+    return Trainer(cfg, rt, model, loader), loader
+
+
+def test_opt_state_lives_in_host_memory(cpu8):
+    if not state_lib.supports_memory_kind(cpu8.mesh, "pinned_host"):
+        pytest.skip("no pinned_host memory on this backend")
+    trainer, loader = _trainer(cpu8, offload=True)
+    kinds = {leaf.sharding.memory_kind
+             for leaf in jax.tree.leaves(trainer.state["opt_state"])
+             if hasattr(leaf, "sharding") and leaf.ndim >= 1
+             and leaf.size > 1}
+    assert kinds == {"pinned_host"}  # moments offloaded
+    # params stay on device
+    pkinds = {leaf.sharding.memory_kind
+              for leaf in jax.tree.leaves(trainer.state["params"])}
+    assert pkinds == {"device"}
+
+    batch = next(iter(loader.epoch(0)))
+    m1 = trainer.train_step(batch)
+    m2 = trainer.train_step(batch)
+    assert np.isfinite(float(m2["loss"]))
+    # state keeps its memory kind across donated steps
+    kinds = {leaf.sharding.memory_kind
+             for leaf in jax.tree.leaves(trainer.state["opt_state"])
+             if hasattr(leaf, "sharding") and leaf.ndim >= 1
+             and leaf.size > 1}
+    assert kinds == {"pinned_host"}
+    assert float(m2["loss"]) < float(m1["loss"])
+
+
+def test_offload_numerics_identical(cpu8):
+    if not state_lib.supports_memory_kind(cpu8.mesh, "pinned_host"):
+        pytest.skip("no pinned_host memory on this backend")
+    losses = {}
+    for offload in (False, True):
+        trainer, loader = _trainer(cpu8, offload=offload)
+        losses[offload] = [float(trainer.train_step(b)["loss"])
+                           for b in loader.epoch(0)]
+    np.testing.assert_allclose(losses[False], losses[True],
+                               rtol=1e-6, atol=1e-7)
