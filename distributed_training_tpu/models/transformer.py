@@ -60,6 +60,10 @@ class TransformerConfig:
     # extra Pallas launches and compiles far more slowly.
     remat_policy: str = "selective"  # "full" | "selective"
     attention_impl: str = "auto"
+    # Flash-kernel tile overrides (0 → ops/flash_attention defaults);
+    # exposed so the bench sweep can tune them on real hardware.
+    flash_block_q: int = 0
+    flash_block_k: int = 0
     pp_microbatches: int = 4      # microbatches when mesh pp > 1
     pp_schedule: str = "gpipe"    # "gpipe" | "interleaved"
     pp_virtual_stages: int = 2    # chunks/device when interleaved
@@ -213,7 +217,9 @@ class Transformer:
                                      head_axis=head_ax)
             return fn(q, k, v)
         return dot_product_attention(q, k, v, causal=True,
-                                     impl=c.attention_impl)
+                                     impl=c.attention_impl,
+                                     block_q=c.flash_block_q,
+                                     block_k=c.flash_block_k)
 
     # -- init --------------------------------------------------------------
 

@@ -62,16 +62,27 @@ def _jitted_naive(q, k, v, causal, impl):
 
 def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                           causal: bool = True,
-                          impl: str = "auto") -> jax.Array:
+                          impl: str = "auto",
+                          block_q: int | None = None,
+                          block_k: int | None = None) -> jax.Array:
     """Dispatching attention entrypoint. ``impl``:
 
     - "auto": flash on TPU when shapes are tile-friendly, else naive
     - "naive" | "flash" | "ring"
+
+    ``block_q``/``block_k`` override the flash kernel's tile sizes
+    (None → kernel defaults); ignored by the naive path.
     """
     if impl in ("auto", "flash"):
         from distributed_training_tpu.ops import flash_attention as fa
-        if fa.supported(q, k, v) or impl == "flash":
-            return fa.flash_attention(q, k, v, causal=causal)
+        if fa.supported(q, k, v, block_q=block_q or 0,
+                        block_k=block_k or 0) or impl == "flash":
+            kw = {}
+            if block_q:
+                kw["block_q"] = block_q
+            if block_k:
+                kw["block_k"] = block_k
+            return fa.flash_attention(q, k, v, causal=causal, **kw)
         impl = "naive"
     if impl == "naive":
         return _naive_attention(q, k, v, causal)

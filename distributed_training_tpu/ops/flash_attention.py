@@ -60,12 +60,16 @@ def _platform_is_tpu() -> bool:
         return False
 
 
-def supported(q: jax.Array, k: jax.Array, v: jax.Array) -> bool:
+def supported(q: jax.Array, k: jax.Array, v: jax.Array,
+              block_q: int = 0, block_k: int = 0) -> bool:
     """Should auto-dispatch route here? (Else: naive fallback.)
 
     Conservative by design: off-TPU the interpreter would be orders of
     magnitude slower than XLA's fused naive path, and the kernel's
     causal mask assumes Sq == Sk (no bottom-right offset).
+    ``block_q``/``block_k`` are the caller's tile overrides (0 → kernel
+    defaults) — divisibility is checked against the EFFECTIVE tiles so
+    a non-dividing override falls back instead of crashing the trace.
     """
     del v
     if not _platform_is_tpu():
@@ -76,8 +80,8 @@ def supported(q: jax.Array, k: jax.Array, v: jax.Array) -> bool:
         return False
     if q.shape[1] < 128:
         return False
-    bq = min(DEFAULT_BLOCK_Q, q.shape[1])
-    bk = min(DEFAULT_BLOCK_K, k.shape[1])
+    bq = min(block_q or DEFAULT_BLOCK_Q, q.shape[1])
+    bk = min(block_k or DEFAULT_BLOCK_K, k.shape[1])
     if q.shape[1] % bq or k.shape[1] % bk:
         return False
     if q.shape[3] > 256:

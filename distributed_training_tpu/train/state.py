@@ -21,15 +21,18 @@ from distributed_training_tpu.parallel.strategy import ShardingStrategy
 
 def state_specs(strategy: ShardingStrategy,
                 optimizer: optax.GradientTransformation,
-                param_shapes: Any, logical_axes: Any = None) -> dict:
+                param_shapes: Any, logical_axes: Any = None,
+                opt_shapes: Any = None) -> dict:
     """PartitionSpecs for the full train state.
 
     Optimizer-state leaves that mirror params (Adam moments, momentum)
     inherit the param's spec via ``optax.tree_map_params``; scalar/other
-    leaves replicate.
+    leaves replicate. ``opt_shapes`` may be precomputed by the caller
+    (the trainer shares one abstract trace with state_shardings).
     """
     param_specs = strategy.specs_for_tree(param_shapes, logical_axes)
-    opt_shapes = jax.eval_shape(optimizer.init, param_shapes)
+    if opt_shapes is None:
+        opt_shapes = jax.eval_shape(optimizer.init, param_shapes)
 
     def spec_for_opt_leaf(leaf, spec):
         # Optimizer state that is not param-shaped cannot inherit the

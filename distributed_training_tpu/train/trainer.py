@@ -182,13 +182,14 @@ class Trainer:
         param_shapes = jax.eval_shape(model.init, self.init_rng)
         logical = (model.logical_axes()
                    if hasattr(model, "logical_axes") else None)
+        opt_shapes = jax.eval_shape(self.optimizer.init, param_shapes)
         self.state_shardings = state_lib.state_shardings(
             runtime.mesh,
             state_lib.state_specs(self.strategy, self.optimizer,
-                                  param_shapes, logical),
+                                  param_shapes, logical,
+                                  opt_shapes=opt_shapes),
             offload_opt_state=tcfg.offload_opt_state,
-            opt_shapes=(jax.eval_shape(self.optimizer.init, param_shapes)
-                        if tcfg.offload_opt_state else None))
+            opt_shapes=opt_shapes if tcfg.offload_opt_state else None)
         # Offload: the compiled step is pure device compute; the
         # trainer streams opt-state host<->device around it. The
         # device-residency variant of the sharding tree drives the jit.
@@ -379,8 +380,10 @@ class Trainer:
                 self.metrics.record_scalar(self.global_step, "val_loss",
                                            val_loss, epoch=epoch)
             preempted = self._stop_agreed
+            save_every = self.cfg.train.save_every
             if self.checkpointer is not None and (
-                    preempted or epoch % self.cfg.train.save_every == 0):
+                    preempted or (save_every > 0
+                                  and epoch % save_every == 0)):
                 # Collective save: every process participates (fixes the
                 # reference's rank-0-only FSDP save hang, SURVEY.md §8 B6).
                 # On preemption: save whatever we have, mid-epoch

@@ -23,6 +23,7 @@ def test_config_inventory_matches_baseline():
         n_baseline = len(json.load(f)["configs"])
     assert n_baseline == 5
     extensions = {"bytes_lm_real"}
+    assert extensions <= set(bench_run.CONFIGS)
     assert len(set(bench_run.CONFIGS) - extensions) == n_baseline
 
 

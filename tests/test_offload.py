@@ -66,3 +66,45 @@ def test_offload_numerics_identical(cpu8):
                            for b in loader.epoch(0)]
     np.testing.assert_allclose(losses[False], losses[True],
                                rtol=1e-6, atol=1e-7)
+
+
+def test_offload_checkpoint_roundtrip(cpu8, tmp_path):
+    """Save with offloaded moments, resume into offloaded residency."""
+    if not state_lib.supports_memory_kind(cpu8.mesh, "pinned_host"):
+        pytest.skip("no pinned_host memory on this backend")
+    from distributed_training_tpu.checkpoint import Checkpointer
+
+    def build():
+        cfg = Config()
+        cfg.train.batch_size = 4
+        cfg.train.total_epochs = 2
+        cfg.train.save_every = 1
+        cfg.train.log_every = 0
+        cfg.train.learning_rate = 0.05
+        cfg.train.optimizer = "adamw"
+        cfg.train.parallel_strategy = "fsdp"
+        cfg.train.min_shard_elems = 1
+        cfg.train.offload_opt_state = True
+        cfg.train.snapshot_path = str(tmp_path / "ckpt")
+        ds = SyntheticRegressionDataset(size=32, seed=0, kind="linear")
+        loader = ShardedDataLoader(ds, cpu8, batch_size=4,
+                                   shuffle=False)
+        model = MLP(input_size=20, output_size=1, hidden_sizes=(64,))
+        ckpt = Checkpointer(cfg.train.snapshot_path, async_save=False)
+        return Trainer(cfg, cpu8, model, loader, ckpt), ckpt
+
+    t1, c1 = build()
+    t1.train()
+    params1 = jax.tree.map(np.asarray, t1.state["params"])
+    c1.close()
+
+    t2, c2 = build()
+    assert t2.epochs_run == 2
+    jax.tree.map(lambda a, b: np.testing.assert_array_equal(
+        np.asarray(a), b), t2.state["params"], params1)
+    kinds = {leaf.sharding.memory_kind
+             for leaf in jax.tree.leaves(t2.state["opt_state"])
+             if hasattr(leaf, "sharding") and leaf.ndim >= 1
+             and leaf.size > 1}
+    assert kinds == {"pinned_host"}
+    c2.close()

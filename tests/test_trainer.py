@@ -136,3 +136,26 @@ def test_evaluate(cpu8):
     batches = list(trainer.loader.epoch(0))
     val = trainer.evaluate(batches)
     assert np.isfinite(val)
+
+
+def test_save_every_zero_disables_checkpointing(cpu8, tmp_path):
+    """save_every=0 means 'never save' — regression: it used to crash
+    with ZeroDivisionError when a checkpointer was attached (the CLI
+    always attaches one)."""
+    from distributed_training_tpu.checkpoint import Checkpointer
+    from distributed_training_tpu.data import SyntheticRegressionDataset
+
+    cfg = Config()
+    cfg.train.total_epochs = 2
+    cfg.train.save_every = 0
+    cfg.train.batch_size = 4
+    cfg.train.log_every = 0
+    cfg.train.snapshot_path = str(tmp_path / "ckpt")
+    ds = SyntheticRegressionDataset(size=32, seed=0, kind="linear")
+    loader = ShardedDataLoader(ds, cpu8, batch_size=4, shuffle=False)
+    model = MLP(input_size=20, output_size=1)
+    ckpt = Checkpointer(cfg.train.snapshot_path, async_save=False)
+    trainer = Trainer(cfg, cpu8, model, loader, ckpt)
+    trainer.train()
+    assert ckpt.latest_step() is None  # nothing saved
+    ckpt.close()
