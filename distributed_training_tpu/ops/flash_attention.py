@@ -285,14 +285,17 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0, 0] = dv_acc[:].astype(dv_ref.dtype)
 
 
-def _flash_bwd(q, k, v, out, lse, do, *, causal, block_q, block_k):
+def _flash_bwd(q, k, v, out, lse, do, *, causal, block_q, block_k,
+               delta=None):
     B, H, S, D = q.shape
     Sk = k.shape[2]
     reps = H // k.shape[1]
     scale = D ** -0.5
     nq, nk = S // block_q, Sk // block_k
-    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
-                    axis=-1, keepdims=True)  # (B, H, S, 1) — fuses in XLA
+    if delta is None:  # callers in a loop precompute it (loop-invariant)
+        delta = jnp.sum(
+            do.astype(jnp.float32) * out.astype(jnp.float32),
+            axis=-1, keepdims=True)  # (B, H, S, 1) — fuses in XLA
 
     interp = not _platform_is_tpu()
     dq = pl.pallas_call(

@@ -22,6 +22,13 @@ The rotation runs a full cycle regardless (uniform collective schedule
 on every device — no data-dependent communication), so causal skipping
 saves FLOPs, not bandwidth.
 
+Per-block attention dispatches to the Pallas flash kernels when the
+local shard is tile-friendly (``block_impl="auto"``): each ring step is
+then MXU-tiled with O(tile) score memory — the blockwise-transformer
+composition the ring paper assumes — falling back to the fused-einsum
+reference otherwise. The merge works on (normalized out, logsumexp)
+pairs, which both block implementations produce.
+
 Backward is a REVERSE-RING custom VJP, not autodiff: autodiff through
 the scan would save each step's rotated KV carries (O(S_global) per
 device — the memory scaling ring attention exists to avoid). Instead
@@ -48,9 +55,12 @@ from jax.experimental.shard_map import shard_map
 from distributed_training_tpu.runtime import AXIS_SP, BATCH_AXES
 
 
-def _block_attn_with_lse(q, k, v, mode: str):
-    """Blockwise attention returning (out_unnorm, m, l) online-softmax
-    state. q: (B, Sq, H, D); k/v: (B, Sk, Hkv, D); fp32 statistics."""
+NEG_INF = -1e30
+
+
+def _block_attn_naive(q, k, v, mode: str):
+    """XLA-einsum block attention → (out_norm (B,Sq,H,D) f32,
+    lse (B,H,Sq) f32). The numerics reference for the flash block."""
     B, Sq, H, D = q.shape
     Hkv = k.shape[2]
     group = H // Hkv
@@ -62,22 +72,57 @@ def _block_attn_with_lse(q, k, v, mode: str):
         mask = (jnp.arange(Sk)[None, :]
                 <= (jnp.arange(Sq)[:, None] + (Sk - Sq)))
         s = jnp.where(mask[None, None, None], s, -jnp.inf)
-    m = jnp.max(s, axis=-1)                          # (B,Hkv,g,Sq)
-    m = jnp.maximum(m, -1e30)  # all-masked rows
+    m = jnp.maximum(jnp.max(s, axis=-1), NEG_INF)    # (B,Hkv,g,Sq)
     p = jnp.exp(s - m[..., None])
-    l = jnp.sum(p, axis=-1)                          # (B,Hkv,g,Sq)
+    l = jnp.maximum(jnp.sum(p, axis=-1), 1e-30)
     o = jnp.einsum("bhgqk,bkhd->bhgqd", p.astype(v.dtype), v,
-                   preferred_element_type=jnp.float32)  # unnormalized
-    return o, m, l
+                   preferred_element_type=jnp.float32) / l[..., None]
+    out = o.transpose(0, 3, 1, 2, 4).reshape(B, Sq, H, D)
+    lse = (m + jnp.log(l)).reshape(B, Hkv * group, Sq)
+    return out, lse
 
 
-def _merge(o_a, m_a, l_a, o_b, m_b, l_b):
-    """Merge two online-softmax partial states."""
-    m = jnp.maximum(m_a, m_b)
-    wa = jnp.exp(m_a - m)
-    wb = jnp.exp(m_b - m)
-    return (o_a * wa[..., None] + o_b * wb[..., None],
-            m, l_a * wa + l_b * wb)
+def _flash_block_ok(q, k, block_impl: str) -> bool:
+    """Route this block through the Pallas flash kernel? Static
+    decision (shapes are static under jit/shard_map)."""
+    from distributed_training_tpu.ops import flash_attention as fa
+    if block_impl == "naive":
+        return False
+    if block_impl == "flash":
+        return True
+    # auto: same tile-friendliness rules as single-device dispatch
+    # (incl. Sq == Sk, which ring blocks always satisfy).
+    return fa.supported(q, k, k)
+
+
+def _block_attn(q, k, v, mode: str, block_impl: str):
+    """One ring block → (out_norm (B,Sq,H,D) f32, lse (B,H,Sq) f32),
+    via the Pallas flash kernel when tile-friendly (MXU-tiled, O(tile)
+    scores memory) else the einsum reference (O(Sq·Sk) scores)."""
+    if _flash_block_ok(q, k, block_impl):
+        from distributed_training_tpu.ops import flash_attention as fa
+        qt = jnp.transpose(q, (0, 2, 1, 3))
+        kt = jnp.transpose(k, (0, 2, 1, 3))
+        vt = jnp.transpose(v, (0, 2, 1, 3))
+        bq = min(fa.DEFAULT_BLOCK_Q, q.shape[1])
+        bk = min(fa.DEFAULT_BLOCK_K, k.shape[1])
+        out, lse = fa._flash_fwd(qt, kt, vt, causal=(mode == "causal"),
+                                 block_q=bq, block_k=bk)
+        return (jnp.transpose(out, (0, 2, 1, 3)).astype(jnp.float32),
+                lse[..., 0])
+    return _block_attn_naive(q, k, v, mode)
+
+
+def _merge(out_a, lse_a, out_b, lse_b):
+    """Merge two normalized partial attentions with their logsumexps:
+    softmax over the union = lse-weighted convex combination."""
+    lse = jnp.logaddexp(lse_a, lse_b)                  # (B,H,S)
+    wa = jnp.exp(lse_a - lse)
+    wb = jnp.exp(lse_b - lse)
+    # (B,H,S) weights onto (B,S,H,D) outputs
+    wa = jnp.transpose(wa, (0, 2, 1))[..., None]
+    wb = jnp.transpose(wb, (0, 2, 1))[..., None]
+    return out_a * wa + out_b * wb, lse
 
 
 def _ring_perm(sp: int):
@@ -86,71 +131,64 @@ def _ring_perm(sp: int):
     return [(i, (i + 1) % sp) for i in range(sp)]
 
 
-def _ring_fwd_scan(q, k, v, axis_name: str, causal: bool):
+def _ring_fwd_scan(q, k, v, axis_name: str, causal: bool,
+                   block_impl: str):
     """Full ring cycle of online-softmax accumulation. Returns the
-    normalized output (B, S, H, D) and per-row logsumexp
-    (B, Hkv, g, S) fp32."""
+    normalized output (B, S, H, D) in q.dtype and per-row logsumexp
+    (B, H, S) fp32."""
     sp = jax.lax.axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     B, S, H, D = q.shape
-    Hkv = k.shape[2]
-    group = H // Hkv
     perm = _ring_perm(sp)
 
-    o0 = jnp.zeros((B, Hkv, group, S, D), jnp.float32)
-    m0 = jnp.full((B, Hkv, group, S), -1e30, jnp.float32)
-    l0 = jnp.zeros((B, Hkv, group, S), jnp.float32)
+    out0 = jnp.zeros((B, S, H, D), jnp.float32)
+    lse0 = jnp.full((B, H, S), NEG_INF, jnp.float32)
 
     def step(carry, t):
-        k_cur, v_cur, o_acc, m_acc, l_acc = carry
+        k_cur, v_cur, out_acc, lse_acc = carry
         src = (idx - t) % sp
 
         def full_block(kv):
-            return _block_attn_with_lse(q, kv[0], kv[1], "full")
+            return _block_attn(q, kv[0], kv[1], "full", block_impl)
 
         def diag_block(kv):
-            return _block_attn_with_lse(q, kv[0], kv[1], "causal")
+            return _block_attn(q, kv[0], kv[1], "causal", block_impl)
 
         def skip_block(kv):
             del kv  # future block: zero contribution, no FLOPs
-            return (jnp.zeros_like(o0), jnp.full_like(m0, -1e30),
-                    jnp.zeros_like(l0))
+            return jnp.zeros_like(out0), jnp.full_like(lse0, NEG_INF)
 
         if causal:
             # 0: past (full), 1: diagonal (causal), 2: future (skip);
             # lax.switch keeps only one branch's FLOPs per step.
             branch = jnp.where(src == idx, 1,
                                jnp.where(src < idx, 0, 2))
-            o_t, m_t, l_t = jax.lax.switch(
+            out_t, lse_t = jax.lax.switch(
                 branch, (full_block, diag_block, skip_block),
                 (k_cur, v_cur))
         else:
-            o_t, m_t, l_t = full_block((k_cur, v_cur))
+            out_t, lse_t = full_block((k_cur, v_cur))
 
-        o_acc, m_acc, l_acc = _merge(o_acc, m_acc, l_acc, o_t, m_t, l_t)
+        out_acc, lse_acc = _merge(out_acc, lse_acc, out_t, lse_t)
         k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
         v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
-        return (k_nxt, v_nxt, o_acc, m_acc, l_acc), None
+        return (k_nxt, v_nxt, out_acc, lse_acc), None
 
-    (k_f, v_f, o_acc, m_acc, l_acc), _ = jax.lax.scan(
-        step, (k, v, o0, m0, l0), jnp.arange(sp))
+    (k_f, v_f, out_acc, lse_acc), _ = jax.lax.scan(
+        step, (k, v, out0, lse0), jnp.arange(sp))
     del k_f, v_f
-
-    l_safe = jnp.maximum(l_acc, 1e-30)
-    out = o_acc / l_safe[..., None]
-    out = out.transpose(0, 3, 1, 2, 4).reshape(B, S, H, D) \
-        .astype(q.dtype)
-    lse = m_acc + jnp.log(l_safe)                 # (B, Hkv, g, S)
-    return out, lse
+    return out_acc.astype(q.dtype), lse_acc
 
 
-def _block_grads(q, k, v, do_g, lse, delta, mode: str):
-    """Gradients of one KV block against the local queries, with the
-    softmax recomputed from the saved logsumexp (``p = exp(s - lse)`` is
-    the *normalized* softmax — no second normalizer pass needed).
+def _block_grads_naive(q, k, v, do_g, lse, delta, mode: str):
+    """Einsum gradients of one KV block against the local queries, with
+    the softmax recomputed from the saved FINAL logsumexp
+    (``p = exp(s - lse)`` is the globally-normalized softmax — the
+    FlashAttention-2 decomposition, so per-block grads sum to the
+    exact total).
 
     q: (B, Sq, H, D); k/v: (B, Sk, Hkv, D); do_g: (B, Hkv, g, Sq, D)
-    fp32; lse/delta: (B, Hkv, g, Sq) fp32. Returns (dq (B,Sq,H,D) f32,
+    fp32; lse/delta: (B, H, Sq) fp32. Returns (dq (B,Sq,H,D) f32,
     dk (B,Sk,Hkv,D) f32, dv likewise)."""
     B, Sq, H, D = q.shape
     Sk = k.shape[1]
@@ -158,18 +196,20 @@ def _block_grads(q, k, v, do_g, lse, delta, mode: str):
     group = H // Hkv
     scale = D ** -0.5
     qg = q.reshape(B, Sq, Hkv, group, D)
+    lse_g = lse.reshape(B, Hkv, group, Sq)
+    delta_g = delta.reshape(B, Hkv, group, Sq)
     s = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k,
                    preferred_element_type=jnp.float32) * scale
     if mode == "causal":
         mask = (jnp.arange(Sk)[None, :]
                 <= (jnp.arange(Sq)[:, None] + (Sk - Sq)))
         s = jnp.where(mask[None, None, None], s, -jnp.inf)
-    p = jnp.exp(s - lse[..., None])                  # (B,Hkv,g,Sq,Sk)
+    p = jnp.exp(s - lse_g[..., None])                # (B,Hkv,g,Sq,Sk)
     dv = jnp.einsum("bhgqk,bhgqd->bkhd", p, do_g,
                     preferred_element_type=jnp.float32)
     dp = jnp.einsum("bhgqd,bkhd->bhgqk", do_g, v.astype(jnp.float32),
                     preferred_element_type=jnp.float32)
-    ds = p * (dp - delta[..., None]) * scale
+    ds = p * (dp - delta_g[..., None]) * scale
     dq = jnp.einsum("bhgqk,bkhd->bqhgd", ds, k.astype(jnp.float32),
                     preferred_element_type=jnp.float32)
     dk = jnp.einsum("bhgqk,bqhgd->bkhd", ds, qg.astype(jnp.float32),
@@ -177,18 +217,39 @@ def _block_grads(q, k, v, do_g, lse, delta, mode: str):
     return dq.reshape(B, Sq, H, D), dk, dv
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def _ring_core(q, k, v, axis_name, causal):
-    out, _ = _ring_fwd_scan(q, k, v, axis_name, causal)
+def _block_grads(q, k, v, do, out, lse, do_g, delta, mode: str,
+                 block_impl: str):
+    """Per-block gradients, via the Pallas flash backward kernels when
+    tile-friendly (same dispatch as forward). The flash path feeds the
+    FINAL (out, lse) — the FA2 trick makes per-block kernels compose
+    into the ring total without any per-block statistics."""
+    if _flash_block_ok(q, k, block_impl):
+        from distributed_training_tpu.ops import flash_attention as fa
+        bq = min(fa.DEFAULT_BLOCK_Q, q.shape[1])
+        bk = min(fa.DEFAULT_BLOCK_K, k.shape[1])
+        t = lambda x: jnp.transpose(x, (0, 2, 1, 3))  # noqa: E731
+        dq, dk, dv = fa._flash_bwd(
+            t(q), t(k), t(v), t(out), lse[..., None], t(do),
+            causal=(mode == "causal"), block_q=bq, block_k=bk,
+            delta=delta[..., None])
+        return (t(dq).astype(jnp.float32),
+                t(dk).astype(jnp.float32),
+                t(dv).astype(jnp.float32))
+    return _block_grads_naive(q, k, v, do_g, lse, delta, mode)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _ring_core(q, k, v, axis_name, causal, block_impl):
+    out, _ = _ring_fwd_scan(q, k, v, axis_name, causal, block_impl)
     return out
 
 
-def _ring_core_fwd(q, k, v, axis_name, causal):
-    out, lse = _ring_fwd_scan(q, k, v, axis_name, causal)
+def _ring_core_fwd(q, k, v, axis_name, causal, block_impl):
+    out, lse = _ring_fwd_scan(q, k, v, axis_name, causal, block_impl)
     return out, (q, k, v, out, lse)
 
 
-def _ring_core_bwd(axis_name, causal, res, do):
+def _ring_core_bwd(axis_name, causal, block_impl, res, do):
     """Reverse ring: KV blocks make a second full rotation; each step
     recomputes that block's softmax and adds its dk/dv contribution into
     accumulators that TRAVEL WITH the block — after sp rotations the
@@ -203,11 +264,14 @@ def _ring_core_bwd(axis_name, causal, res, do):
     group = H // Hkv
     perm = _ring_perm(sp)
 
-    do_g = do.astype(jnp.float32) \
-        .reshape(B, S, Hkv, group, D).transpose(0, 2, 3, 1, 4)
-    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
-                    axis=-1)                        # (B, S, H)
-    delta = delta.reshape(B, S, Hkv, group).transpose(0, 2, 3, 1)
+    do_f = do.astype(jnp.float32)
+    # The grouped-layout dO copy feeds only the einsum block path; the
+    # flash path reads dO directly (don't materialize it there).
+    do_g = (None if _flash_block_ok(q, k, block_impl)
+            else do_f.reshape(B, S, Hkv, group, D)
+            .transpose(0, 2, 3, 1, 4))
+    delta = jnp.sum(do_f * out.astype(jnp.float32), axis=-1)  # (B,S,H)
+    delta = jnp.transpose(delta, (0, 2, 1))                   # (B,H,S)
 
     dq0 = jnp.zeros((B, S, H, D), jnp.float32)
     dk0 = jnp.zeros(k.shape, jnp.float32)
@@ -218,12 +282,12 @@ def _ring_core_bwd(axis_name, causal, res, do):
         src = (idx - t) % sp
 
         def full_block(kv):
-            return _block_grads(q, kv[0], kv[1], do_g, lse, delta,
-                                "full")
+            return _block_grads(q, kv[0], kv[1], do, out, lse, do_g,
+                                delta, "full", block_impl)
 
         def diag_block(kv):
-            return _block_grads(q, kv[0], kv[1], do_g, lse, delta,
-                                "causal")
+            return _block_grads(q, kv[0], kv[1], do, out, lse, do_g,
+                                delta, "causal", block_impl)
 
         def skip_block(kv):
             del kv
@@ -259,29 +323,34 @@ _ring_core.defvjp(_ring_core_fwd, _ring_core_bwd)
 
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                    axis_name: str = AXIS_SP,
-                   causal: bool = True) -> jax.Array:
+                   causal: bool = True,
+                   block_impl: str = "auto") -> jax.Array:
     """Sequence-parallel attention; call INSIDE shard_map.
 
     Shapes are per-device shards: q/k/v (B, S_local, H|Hkv, D) where the
     global sequence is the concatenation of shards in ``axis_name``
-    order. Output matches q's shape/dtype.
+    order. Output matches q's shape/dtype. ``block_impl``: per-block
+    attention kernel — "auto" uses the Pallas flash kernel when the
+    local shard is tile-friendly (fwd AND reverse-ring bwd), else the
+    einsum reference; "naive"/"flash" force a path.
     """
     sp = jax.lax.axis_size(axis_name)
-    B, S, H, D = q.shape
 
     if sp == 1:
-        o, m, l = _block_attn_with_lse(q, k, v,
-                                       "causal" if causal else "full")
-        out = o / jnp.maximum(l, 1e-30)[..., None]
-        return out.transpose(0, 3, 1, 2, 4).reshape(B, S, H, D) \
-            .astype(q.dtype)
+        # Degenerate ring: plain block attention under autodiff (the
+        # naive block — the Pallas fwd kernel alone has no vjp outside
+        # the ring's custom VJP).
+        out, _ = _block_attn_naive(q, k, v,
+                                   "causal" if causal else "full")
+        return out.astype(q.dtype)
 
-    return _ring_core(q, k, v, axis_name, causal)
+    return _ring_core(q, k, v, axis_name, causal, block_impl)
 
 
 def make_ring_attention(mesh: Mesh, causal: bool = True,
                         batch_axes=BATCH_AXES,
-                        head_axis: str | None = None):
+                        head_axis: str | None = None,
+                        block_impl: str = "auto"):
     """Build the shard_map'd ring-attention fn over global (B, S, H, D)
     arrays: batch over ``batch_axes``, sequence over ``sp``, heads over
     ``head_axis`` (pass ``tp`` to compose SP with tensor parallelism).
@@ -289,7 +358,7 @@ def make_ring_attention(mesh: Mesh, causal: bool = True,
     spec = P(tuple(batch_axes) or None, AXIS_SP, head_axis, None)
     return shard_map(
         functools.partial(ring_attention, axis_name=AXIS_SP,
-                          causal=causal),
+                          causal=causal, block_impl=block_impl),
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
