@@ -45,6 +45,29 @@ def gather_full_state(state: Any, mesh: Mesh) -> Any:
     return jax.tree.map(to_host, state)
 
 
+def write_artifact(path: str, state: Any, meta: dict | None) -> int:
+    """Serialize ``{"state", "meta"}`` (the load_consolidated contract)
+    and write it atomically (temp file + rename). Returns byte count.
+    Shared by the collective export and the offline CLI so the payload
+    format cannot drift between them."""
+    payload = {
+        "state": serialization.to_state_dict(state),
+        "meta": dict(meta or {}),
+    }
+    blob = serialization.msgpack_serialize(payload)
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path) or ".",
+                               suffix=".tmp")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(blob)
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+    return len(blob)
+
+
 def export_consolidated(path: str, state: Any, mesh: Mesh,
                         meta: dict | None = None) -> str:
     """Write the full (gathered) state as ONE portable msgpack file.
@@ -54,24 +77,10 @@ def export_consolidated(path: str, state: Any, mesh: Mesh,
     no process races ahead of the durable artifact.
     """
     full = gather_full_state(state, mesh)
-    payload = {
-        "state": serialization.to_state_dict(full),
-        "meta": dict(meta or {}),
-    }
     if jax.process_index() == 0:
-        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-        blob = serialization.msgpack_serialize(payload)
-        fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path) or ".",
-                                   suffix=".tmp")
-        try:
-            with os.fdopen(fd, "wb") as f:
-                f.write(blob)
-            os.replace(tmp, path)
-        finally:
-            if os.path.exists(tmp):
-                os.unlink(tmp)
+        n = write_artifact(path, full, meta)
         logger.info("consolidated checkpoint exported: %s (%d bytes)",
-                    path, len(blob))
+                    path, n)
     if jax.process_count() > 1:
         from jax.experimental import multihost_utils
         multihost_utils.sync_global_devices("consolidated_export")

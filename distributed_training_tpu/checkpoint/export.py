@@ -1,0 +1,89 @@
+"""CLI: consolidate an existing Orbax checkpoint into one file.
+
+Offline counterpart of ``train.gather_on_save`` — point it at a
+checkpoint directory the trainer wrote and get the single portable
+msgpack artifact (checkpoint/consolidate.py format) without
+reconstructing the model or mesh. Single-process tool: it restores
+shards to host memory, so it is meant for a workstation with enough
+RAM, not a pod (use gather_on_save there — its gather stays sharded
+until the collective).
+
+    python -m distributed_training_tpu.checkpoint.export \
+        --ckpt outputs/default/checkpoints --out model.msgpack
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def export(ckpt_dir: str, out_path: str, step: int | None = None) -> dict:
+    import jax
+
+    # Site customizations may pin the platform at interpreter start,
+    # overriding the env var — re-apply it so JAX_PLATFORMS=cpu really
+    # does keep this host-side tool off the accelerator.
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+    import orbax.checkpoint as ocp
+    from jax.sharding import SingleDeviceSharding
+
+    ckpt_dir = os.path.abspath(ckpt_dir)
+    if step is None:
+        steps = sorted(int(d) for d in os.listdir(ckpt_dir)
+                       if d.isdigit())
+        if not steps:
+            raise FileNotFoundError(
+                f"no checkpoint steps found under {ckpt_dir}")
+        step = steps[-1]
+    state_path = os.path.join(ckpt_dir, str(step), "state")
+    if not os.path.isdir(state_path):
+        raise FileNotFoundError(f"{state_path} does not exist")
+
+    # Restore every leaf onto the local default device via the
+    # checkpoint's own tree metadata — NOT the saved shardings: the
+    # whole point of this tool is consolidating a pod checkpoint on a
+    # machine with a different (usually single-device) topology.
+    dev = jax.devices()[0]
+    ckptr = ocp.PyTreeCheckpointer()
+    tree = ckptr.metadata(state_path).item_metadata.tree
+    restore_args = jax.tree.map(
+        lambda _m: ocp.ArrayRestoreArgs(
+            sharding=SingleDeviceSharding(dev)), tree)
+    state = ckptr.restore(
+        state_path,
+        args=ocp.args.PyTreeRestore(restore_args=restore_args))
+
+    meta: dict = {}
+    meta_file = os.path.join(ckpt_dir, str(step), "meta", "metadata")
+    if os.path.exists(meta_file):
+        with open(meta_file) as f:
+            meta = json.load(f) or {}
+    meta.setdefault("step", int(step))
+
+    from distributed_training_tpu.checkpoint.consolidate import (
+        write_artifact,
+    )
+    n = write_artifact(out_path,
+                       jax.tree.map(jax.device_get, state), meta)
+    return {"out": out_path, "step": int(step), "bytes": n}
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--ckpt", required=True,
+                   help="Orbax checkpoint directory (snapshot_path)")
+    p.add_argument("--out", required=True, help="output .msgpack path")
+    p.add_argument("--step", type=int, default=None,
+                   help="checkpoint step (default: latest)")
+    args = p.parse_args(argv)
+    print(json.dumps(export(args.ckpt, args.out, args.step)))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -144,10 +144,13 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         lse_ref[0, 0] = m_ref[:] + jnp.log(l_safe)  # (bq, 1)
 
 
-def _flash_fwd(q, k, v, *, causal, block_q, block_k):
+def _flash_fwd(q, k, v, *, causal, block_q, block_k, out_dtype=None):
     """q: (B, H, S, D); k/v: (B, Hkv, Sk, D) with Hkv dividing H — GQA is
     expressed in the KV BlockSpec index maps (h → h // reps), so grouped
-    KV heads are never materialized at H resolution in HBM."""
+    KV heads are never materialized at H resolution in HBM.
+    ``out_dtype``: output dtype (default q.dtype); ring callers pass
+    f32 so per-block partials aren't rounded before the merge."""
+    out_dtype = out_dtype or q.dtype
     B, H, S, D = q.shape
     Sk = k.shape[2]
     reps = H // k.shape[1]
@@ -179,7 +182,7 @@ def _flash_fwd(q, k, v, *, causal, block_q, block_k):
                          lambda b, h, qi, ki: (b, h, qi, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((B, H, S, D), q.dtype),
+            jax.ShapeDtypeStruct((B, H, S, D), out_dtype),
             jax.ShapeDtypeStruct((B, H, S, 1), jnp.float32),
         ],
         scratch_shapes=[
@@ -286,17 +289,24 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _flash_bwd(q, k, v, out, lse, do, *, causal, block_q, block_k,
-               delta=None):
+               delta=None, grads_dtype=None):
+    """``out`` is consumed only to derive ``delta``; callers that
+    precompute delta (it is loop-invariant in the ring) pass
+    ``out=None`` and skip that read entirely. ``grads_dtype`` overrides
+    the dq/dk/dv dtype (default: match the inputs); ring callers pass
+    f32 so per-block gradient partials aren't rounded before their
+    cross-block accumulation."""
     B, H, S, D = q.shape
     Sk = k.shape[2]
     reps = H // k.shape[1]
     scale = D ** -0.5
     nq, nk = S // block_q, Sk // block_k
-    if delta is None:  # callers in a loop precompute it (loop-invariant)
+    if delta is None:
         delta = jnp.sum(
             do.astype(jnp.float32) * out.astype(jnp.float32),
             axis=-1, keepdims=True)  # (B, H, S, 1) — fuses in XLA
 
+    gdt = grads_dtype
     interp = not _platform_is_tpu()
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, block_q=block_q,
@@ -318,7 +328,7 @@ def _flash_bwd(q, k, v, out, lse, do, *, causal, block_q, block_k,
         ],
         out_specs=pl.BlockSpec((1, 1, block_q, D),
                                lambda b, h, qi, ki: (b, h, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct((B, H, S, D), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((B, H, S, D), gdt or q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
         interpret=interp,
     )(q, k, v, do, lse, delta)
@@ -350,8 +360,8 @@ def _flash_bwd(q, k, v, out, lse, do, *, causal, block_q, block_k,
                          lambda b, h, ki, qi: (b, h, ki, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((B, H, Sk, D), k.dtype),
-            jax.ShapeDtypeStruct((B, H, Sk, D), v.dtype),
+            jax.ShapeDtypeStruct((B, H, Sk, D), gdt or k.dtype),
+            jax.ShapeDtypeStruct((B, H, Sk, D), gdt or v.dtype),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_k, D), jnp.float32),

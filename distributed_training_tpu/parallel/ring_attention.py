@@ -84,33 +84,66 @@ def _block_attn_naive(q, k, v, mode: str):
 
 def _flash_block_ok(q, k, block_impl: str) -> bool:
     """Route this block through the Pallas flash kernel? Static
-    decision (shapes are static under jit/shard_map)."""
+    decision (shapes are static under jit/shard_map). Forcing
+    ``"flash"`` with non-tile-friendly shards raises: the kernel grid
+    would silently leave output rows unwritten (partial tiles), and
+    garbage propagated through the ring merge is far worse than a
+    trace-time error."""
     from distributed_training_tpu.ops import flash_attention as fa
     if block_impl == "naive":
         return False
     if block_impl == "flash":
+        S, Sk = q.shape[1], k.shape[1]
+        bq = min(fa.DEFAULT_BLOCK_Q, S)
+        bk = min(fa.DEFAULT_BLOCK_K, Sk)
+        if S % bq or Sk % bk:
+            raise ValueError(
+                f"block_impl='flash' forced but local shard lengths "
+                f"({S}, {Sk}) are not divisible by the kernel tiles "
+                f"({bq}, {bk}); pad the sequence or use 'auto'")
+        if q.shape[2] % k.shape[2]:
+            # A non-dividing group would make the kernel's h // reps
+            # KV index map read out-of-range blocks (Pallas clamps —
+            # silently wrong heads, no error).
+            raise ValueError(
+                f"block_impl='flash': n_heads {q.shape[2]} not "
+                f"divisible by n_kv_heads {k.shape[2]}")
+        if q.dtype not in (jnp.float32, jnp.bfloat16):
+            raise ValueError(
+                f"block_impl='flash': unsupported dtype {q.dtype} "
+                "(float32/bfloat16 only)")
         return True
     # auto: same tile-friendliness rules as single-device dispatch
     # (incl. Sq == Sk, which ring blocks always satisfy).
     return fa.supported(q, k, k)
 
 
-def _block_attn(q, k, v, mode: str, block_impl: str):
-    """One ring block → (out_norm (B,Sq,H,D) f32, lse (B,H,Sq) f32),
-    via the Pallas flash kernel when tile-friendly (MXU-tiled, O(tile)
-    scores memory) else the einsum reference (O(Sq·Sk) scores)."""
-    if _flash_block_ok(q, k, block_impl):
-        from distributed_training_tpu.ops import flash_attention as fa
-        qt = jnp.transpose(q, (0, 2, 1, 3))
-        kt = jnp.transpose(k, (0, 2, 1, 3))
-        vt = jnp.transpose(v, (0, 2, 1, 3))
-        bq = min(fa.DEFAULT_BLOCK_Q, q.shape[1])
-        bk = min(fa.DEFAULT_BLOCK_K, k.shape[1])
-        out, lse = fa._flash_fwd(qt, kt, vt, causal=(mode == "causal"),
-                                 block_q=bq, block_k=bk)
-        return (jnp.transpose(out, (0, 2, 1, 3)).astype(jnp.float32),
-                lse[..., 0])
-    return _block_attn_naive(q, k, v, mode)
+def _bhsd(x):
+    return jnp.transpose(x, (0, 2, 1, 3))
+
+
+def _flash_blocks(qt):
+    """Tile sizes for a (B,H,S,D)-layout ring block."""
+    from distributed_training_tpu.ops import flash_attention as fa
+    return (min(fa.DEFAULT_BLOCK_Q, qt.shape[2]),
+            min(fa.DEFAULT_BLOCK_K, qt.shape[2]))
+
+
+def _block_attn_flash(qt, k, v, mode: str):
+    """One ring block via the Pallas flash kernel (MXU-tiled, O(tile)
+    scores memory). ``qt`` is the loop-invariant (B,H,S,D) transpose of
+    the local queries — hoisted out of the ring scan by the caller
+    (k/v rotate, so their transposes legitimately live in the step)."""
+    from distributed_training_tpu.ops import flash_attention as fa
+    bq, bk = _flash_blocks(qt)
+    # f32 out: per-block partials must not round to the input dtype
+    # before the cross-block merge (the naive path is f32 throughout;
+    # single-device flash rounds exactly once, at the very end).
+    out, lse = fa._flash_fwd(qt, _bhsd(k), _bhsd(v),
+                             causal=(mode == "causal"),
+                             block_q=bq, block_k=bk,
+                             out_dtype=jnp.float32)
+    return _bhsd(out), lse[..., 0]
 
 
 def _merge(out_a, lse_a, out_b, lse_b):
@@ -141,6 +174,16 @@ def _ring_fwd_scan(q, k, v, axis_name: str, causal: bool,
     B, S, H, D = q.shape
     perm = _ring_perm(sp)
 
+    use_flash = _flash_block_ok(q, k, block_impl)
+    # Loop-invariant: hoisted here because XLA's while-loop LICM does
+    # not lift computations out of lax.switch branch computations.
+    qt = _bhsd(q) if use_flash else None
+
+    def block(kv, mode):
+        if use_flash:
+            return _block_attn_flash(qt, kv[0], kv[1], mode)
+        return _block_attn_naive(q, kv[0], kv[1], mode)
+
     out0 = jnp.zeros((B, S, H, D), jnp.float32)
     lse0 = jnp.full((B, H, S), NEG_INF, jnp.float32)
 
@@ -149,10 +192,10 @@ def _ring_fwd_scan(q, k, v, axis_name: str, causal: bool,
         src = (idx - t) % sp
 
         def full_block(kv):
-            return _block_attn(q, kv[0], kv[1], "full", block_impl)
+            return block(kv, "full")
 
         def diag_block(kv):
-            return _block_attn(q, kv[0], kv[1], "causal", block_impl)
+            return block(kv, "causal")
 
         def skip_block(kv):
             del kv  # future block: zero contribution, no FLOPs
@@ -217,25 +260,19 @@ def _block_grads_naive(q, k, v, do_g, lse, delta, mode: str):
     return dq.reshape(B, Sq, H, D), dk, dv
 
 
-def _block_grads(q, k, v, do, out, lse, do_g, delta, mode: str,
-                 block_impl: str):
-    """Per-block gradients, via the Pallas flash backward kernels when
-    tile-friendly (same dispatch as forward). The flash path feeds the
-    FINAL (out, lse) — the FA2 trick makes per-block kernels compose
-    into the ring total without any per-block statistics."""
-    if _flash_block_ok(q, k, block_impl):
-        from distributed_training_tpu.ops import flash_attention as fa
-        bq = min(fa.DEFAULT_BLOCK_Q, q.shape[1])
-        bk = min(fa.DEFAULT_BLOCK_K, k.shape[1])
-        t = lambda x: jnp.transpose(x, (0, 2, 1, 3))  # noqa: E731
-        dq, dk, dv = fa._flash_bwd(
-            t(q), t(k), t(v), t(out), lse[..., None], t(do),
-            causal=(mode == "causal"), block_q=bq, block_k=bk,
-            delta=delta[..., None])
-        return (t(dq).astype(jnp.float32),
-                t(dk).astype(jnp.float32),
-                t(dv).astype(jnp.float32))
-    return _block_grads_naive(q, k, v, do_g, lse, delta, mode)
+def _block_grads_flash(qt, dot, k, v, lse, delta, mode: str):
+    """Per-block gradients via the Pallas flash backward kernels. Feeds
+    the FINAL (lse, delta) — the FA2 trick makes per-block kernels
+    compose into the ring total without any per-block statistics.
+    ``qt``/``dot`` are the loop-invariant (B,H,S,D) transposes of the
+    local queries / upstream grads, hoisted out of the ring scan."""
+    from distributed_training_tpu.ops import flash_attention as fa
+    bq, bk = _flash_blocks(qt)
+    dq, dk, dv = fa._flash_bwd(
+        qt, _bhsd(k), _bhsd(v), None, lse[..., None], dot,
+        causal=(mode == "causal"), block_q=bq, block_k=bk,
+        delta=delta[..., None], grads_dtype=jnp.float32)
+    return _bhsd(dq), _bhsd(dk), _bhsd(dv)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
@@ -265,13 +302,26 @@ def _ring_core_bwd(axis_name, causal, block_impl, res, do):
     perm = _ring_perm(sp)
 
     do_f = do.astype(jnp.float32)
-    # The grouped-layout dO copy feeds only the einsum block path; the
-    # flash path reads dO directly (don't materialize it there).
-    do_g = (None if _flash_block_ok(q, k, block_impl)
-            else do_f.reshape(B, S, Hkv, group, D)
-            .transpose(0, 2, 3, 1, 4))
     delta = jnp.sum(do_f * out.astype(jnp.float32), axis=-1)  # (B,S,H)
     delta = jnp.transpose(delta, (0, 2, 1))                   # (B,H,S)
+
+    # Loop-invariant per-path precomputes, hoisted out of the scan
+    # (XLA's while-loop LICM does not lift out of switch branches):
+    # flash wants (B,H,S,D) q/dO; the einsum path wants grouped dO.
+    use_flash = _flash_block_ok(q, k, block_impl)
+    if use_flash:
+        qt, dot, do_g = _bhsd(q), _bhsd(do), None
+    else:
+        qt = dot = None
+        do_g = do_f.reshape(B, S, Hkv, group, D) \
+            .transpose(0, 2, 3, 1, 4)
+
+    def block_grads(kv, mode):
+        if use_flash:
+            return _block_grads_flash(qt, dot, kv[0], kv[1], lse,
+                                      delta, mode)
+        return _block_grads_naive(q, kv[0], kv[1], do_g, lse, delta,
+                                  mode)
 
     dq0 = jnp.zeros((B, S, H, D), jnp.float32)
     dk0 = jnp.zeros(k.shape, jnp.float32)
@@ -282,12 +332,10 @@ def _ring_core_bwd(axis_name, causal, block_impl, res, do):
         src = (idx - t) % sp
 
         def full_block(kv):
-            return _block_grads(q, kv[0], kv[1], do, out, lse, do_g,
-                                delta, "full", block_impl)
+            return block_grads(kv, "full")
 
         def diag_block(kv):
-            return _block_grads(q, kv[0], kv[1], do, out, lse, do_g,
-                                delta, "causal", block_impl)
+            return block_grads(kv, "causal")
 
         def skip_block(kv):
             del kv

@@ -171,3 +171,44 @@ def test_consolidated_export_roundtrip(cpu8, tmp_path):
         lambda x: jax.device_put(x, replicated), saved_params)
     for key, v in walk(jax.tree.map(np.asarray, restored)):
         np.testing.assert_array_equal(v, live_flat[key])
+
+
+def test_offline_export_cli(cpu8, tmp_path):
+    """checkpoint/export.py consolidates an existing Orbax dir into the
+    same portable format as gather_on_save, without model/mesh."""
+    import subprocess
+    import sys
+
+    from distributed_training_tpu.checkpoint import load_consolidated
+
+    trainer, ckpt = build(cpu8, tmp_path, epochs=2)
+    trainer.train()
+    ckpt.close()
+    live = jax.tree.map(np.asarray, trainer.state["params"])
+
+    out = str(tmp_path / "exported.msgpack")
+    # Strip the 8-device flag: the tool must consolidate a checkpoint
+    # saved on a DIFFERENT topology (here: 8 devices -> 1).
+    env = {**__import__("os").environ, "JAX_PLATFORMS": "cpu"}
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.run(
+        [sys.executable, "-m",
+         "distributed_training_tpu.checkpoint.export",
+         "--ckpt", str(tmp_path / "ckpt"), "--out", out],
+        capture_output=True, text=True, env=env)
+    assert proc.returncode == 0, proc.stderr[-500:]
+    state_dict, meta = load_consolidated(out)
+    assert meta["step"] == trainer.global_step
+
+    def leaves(d, prefix=()):
+        for k, v in d.items():
+            if isinstance(v, dict):
+                yield from leaves(v, prefix + (k,))
+            else:
+                yield prefix + (k,), v
+
+    live_flat = dict(leaves(live))
+    saved_flat = dict(leaves(state_dict["params"]))
+    assert set(live_flat) == set(saved_flat)
+    for key, val in live_flat.items():
+        np.testing.assert_array_equal(val, saved_flat[key])

@@ -48,10 +48,27 @@ def test_sharding_divides_state():
 
 
 def test_activations_scale_with_batch():
-    c = cfg()
-    a = memory.estimate_transformer_memory(c, 4, 64).activations_gib
-    b = memory.estimate_transformer_memory(c, 8, 64).activations_gib
+    """Dense loss head: activations scale linearly with batch. Fused
+    head: the per-chunk logits tile is a CONSTANT (that's the point),
+    so scaling is affine — the batch-dependent part still doubles."""
+    cd = cfg(loss_impl="dense")
+    a = memory.estimate_transformer_memory(cd, 4, 64).activations_gib
+    b = memory.estimate_transformer_memory(cd, 8, 64).activations_gib
     assert b == pytest.approx(2 * a, rel=1e-6)
+
+    cf = cfg()  # fused default
+    f0 = memory.estimate_transformer_memory(cf, 1, 64).activations_gib
+    f4 = memory.estimate_transformer_memory(cf, 4, 64).activations_gib
+    f8 = memory.estimate_transformer_memory(cf, 8, 64).activations_gib
+    # affine in batch: f(b) = const + b * slope
+    assert f8 - f4 == pytest.approx((f4 - f0) * 4 / 3, rel=1e-6)
+    # fused beats dense once the token count exceeds the chunk tile
+    # (B·S > chunk_rows; at tiny batches the constant tile dominates)
+    big_d = memory.estimate_transformer_memory(
+        cfg(loss_impl="dense"), 64, 64).activations_gib
+    big_f = memory.estimate_transformer_memory(cfg(), 64, 64) \
+        .activations_gib
+    assert big_f < big_d
 
 
 def test_fits_and_unknown_kind():

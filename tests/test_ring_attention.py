@@ -206,3 +206,19 @@ def test_ring_flash_blocks_gqa():
         _naive_attention(q, k, v, causal=True) ** 2))(q)
     np.testing.assert_allclose(np.asarray(g), np.asarray(gr),
                                rtol=1e-4, atol=1e-4)
+
+
+def test_ring_forced_flash_rejects_partial_tiles():
+    """block_impl='flash' with shard lengths that don't divide the
+    kernel tiles must raise at trace time (a partial grid would leave
+    output rows unwritten and corrupt the merge silently)."""
+    from distributed_training_tpu.parallel.ring_attention import (
+        make_ring_attention,
+    )
+    rt = fake_cpu_runtime(8, sp=2)
+    # S_global=768 -> S_local=384: > 256 but not a multiple of 256
+    q, k, v = rand_qkv(B=1, S=768, H=2, D=8, seed=9)
+    fn = make_ring_attention(rt.mesh, causal=True, batch_axes=(),
+                             block_impl="flash")
+    with pytest.raises(ValueError, match="divisible"):
+        jax.jit(fn)(q, k, v)
