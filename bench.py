@@ -217,11 +217,24 @@ def _resolve_batch() -> int:
 def main() -> None:
     probe_backend()
     watchdog = _arm_watchdog()
+    batch = _resolve_batch()
     try:
-        m = measure(_resolve_batch())
-    except Exception as e:  # noqa: BLE001 — evidence line must survive
-        _fail("measure", f"{type(e).__name__}: {e}")
-        return
+        m = None
+        while True:
+            try:
+                m = measure(batch)
+                break
+            except Exception as e:  # noqa: BLE001
+                # A number at a smaller batch beats no number at all
+                # (an OOM at the planned batch must not zero out the
+                # round's perf evidence). Floor of 4, then give up.
+                _phase("measure_failed", batch=batch,
+                       error=f"{type(e).__name__}")
+                if batch <= 4:
+                    _fail("measure", f"{type(e).__name__}: {e}")
+                    return
+                batch //= 2
+                _phase("retry_smaller_batch", batch=batch)
     finally:
         watchdog.cancel()
     mfu = m.pop("mfu")
