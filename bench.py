@@ -214,25 +214,34 @@ def _resolve_batch() -> int:
     return batch
 
 
+def _is_oom(e: Exception) -> bool:
+    msg = str(e).lower()
+    return ("resource_exhausted" in msg or "out of memory" in msg
+            or "allocat" in msg)
+
+
 def main() -> None:
     probe_backend()
     watchdog = _arm_watchdog()
-    batch = _resolve_batch()
     try:
-        m = None
+        batch = _resolve_batch()
+    except Exception as e:  # noqa: BLE001 — evidence line must survive
+        watchdog.cancel()
+        _fail("resolve_batch", f"{type(e).__name__}: {e}")
+    try:
         while True:
             try:
                 m = measure(batch)
                 break
             except Exception as e:  # noqa: BLE001
-                # A number at a smaller batch beats no number at all
-                # (an OOM at the planned batch must not zero out the
-                # round's perf evidence). Floor of 4, then give up.
                 _phase("measure_failed", batch=batch,
                        error=f"{type(e).__name__}")
-                if batch <= 4:
+                # OOM degrades to a halved batch (a smaller number
+                # beats zeroing the round's perf evidence; floor 4).
+                # Anything else is deterministic — retrying would just
+                # burn the watchdog budget and mask the real error.
+                if not _is_oom(e) or batch <= 4:
                     _fail("measure", f"{type(e).__name__}: {e}")
-                    return
                 batch //= 2
                 _phase("retry_smaller_batch", batch=batch)
     finally:

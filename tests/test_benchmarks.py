@@ -59,3 +59,59 @@ def test_models_construct(name):
     kwargs.update(spec.get("scaled_kwargs", {}))
     model = build_model(model_name, dtype="float32", **kwargs)
     assert model is not None
+
+
+def test_bench_retries_smaller_batch_on_failure(monkeypatch, capsys):
+    """bench.main() degrades to a halved batch instead of zeroing the
+    round's evidence; structured failure JSON only below the floor."""
+    import bench
+
+    monkeypatch.setattr(bench, "probe_backend", lambda: None)
+    calls = []
+
+    def fake_measure(batch, **kw):
+        calls.append(batch)
+        if batch > 8:
+            raise RuntimeError("RESOURCE_EXHAUSTED: fake OOM")
+        del kw
+        return {"mfu": 0.5, "batch": batch, "loss_finite": True}
+
+    monkeypatch.setattr(bench, "measure", fake_measure)
+    monkeypatch.setattr(bench, "_resolve_batch", lambda: 32)
+    bench.main()
+    out = capsys.readouterr().out.strip().splitlines()[-1]
+    rec = json.loads(out)
+    assert calls == [32, 16, 8]
+    assert rec["value"] == 0.5
+    assert rec["detail"]["batch"] == 8
+
+    # below the floor: failure JSON with rc via SystemExit
+    calls.clear()
+
+    def always_fail(batch, **kw):
+        calls.append(batch)
+        raise RuntimeError("RESOURCE_EXHAUSTED: still fake OOM")
+
+    monkeypatch.setattr(bench, "measure", always_fail)
+    monkeypatch.setattr(bench, "_resolve_batch", lambda: 8)
+    import pytest as _pytest
+    with _pytest.raises(SystemExit):
+        bench.main()
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["value"] == 0.0 and rec["error"]["stage"] == "measure"
+    assert calls == [8, 4]
+
+    # non-OOM errors are deterministic: fail fast, no retries
+    calls.clear()
+
+    def type_error(batch, **kw):
+        calls.append(batch)
+        raise TypeError("bad shapes")
+
+    monkeypatch.setattr(bench, "measure", type_error)
+    monkeypatch.setattr(bench, "_resolve_batch", lambda: 32)
+    with _pytest.raises(SystemExit):
+        bench.main()
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["error"]["stage"] == "measure"
+    assert calls == [32]
