@@ -54,6 +54,10 @@ class TrainConfig:
     # device for the update); this frees between-step residency, at
     # the cost of two opt-state transfers per step.
     offload_opt_state: bool = False
+    # Durable metrics stream: coordinator appends every recorded entry
+    # (loss, samples/sec/chip, mfu, val_loss) as one JSON line. Empty →
+    # disabled; the CLI defaults it to <run_dir>/metrics.jsonl.
+    metrics_jsonl: str = ""
     dataset_size: int = 2048
     learning_rate: float = 1e-3
     device: str = "auto"          # "auto" | "tpu" | "cpu"

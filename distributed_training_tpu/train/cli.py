@@ -51,6 +51,8 @@ def main(argv: list[str] | None = None) -> int:
     setup_logging(cfg.run.log_level,
                   os.path.join(run_dir, cfg.run.log_file),
                   rt.process_index)
+    if not cfg.train.metrics_jsonl:
+        cfg.train.metrics_jsonl = os.path.join(run_dir, "metrics.jsonl")
     logger.info("config loaded; %s", rt.describe())
     if rt.is_coordinator:
         save_resolved(cfg, os.path.join(run_dir, "resolved_config.yaml"))

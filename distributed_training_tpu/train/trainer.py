@@ -257,6 +257,9 @@ class Trainer:
             num_devices=runtime.num_devices,
             enabled=runtime.is_coordinator,
             device_kind=runtime.device_kind,
+            jsonl_path=tcfg.metrics_jsonl or None,
+            jsonl_fresh=(restored is None),
+            start_step=self.global_step,
         )
 
     # -- cooperative stop / health ----------------------------------------

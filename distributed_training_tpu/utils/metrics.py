@@ -41,7 +41,16 @@ def compute_mfu(model_flops_per_sec_per_chip: float,
 
 @dataclass
 class MetricsLogger:
-    """Rolling per-step throughput/loss logging on the coordinator."""
+    """Rolling per-step throughput/loss logging on the coordinator.
+
+    ``jsonl_path`` (optional) appends every recorded entry as one JSON
+    line — the durable metrics stream (loss curves, samples/sec/chip,
+    MFU, val_loss) that BASELINE.json's measurement protocol calls for;
+    the reference has only transient log lines (SURVEY.md §5.5).
+    ``jsonl_fresh=True`` truncates the file at the first write (a
+    from-scratch run in a reused run_dir must not interleave with the
+    previous run's rows); resumed runs append, separated by a
+    ``run_start`` marker line carrying the resume step."""
 
     log_every: int = 10
     samples_per_step: int = 0
@@ -49,10 +58,38 @@ class MetricsLogger:
     num_devices: int = 1
     enabled: bool = True
     device_kind: str = "cpu"
+    jsonl_path: str | None = None
+    jsonl_fresh: bool = True
+    start_step: int = 0
 
     _last_time: float = field(default_factory=time.perf_counter)
     _last_step: int = 0
+    _jsonl_ready: bool = field(default=False, repr=False)
     history: list[dict] = field(default_factory=list)
+
+    def _append(self, entry: dict) -> None:
+        self.history.append(entry)
+        if not self.jsonl_path:
+            return
+        import json
+        import math
+        import os
+        if not self._jsonl_ready:
+            os.makedirs(os.path.dirname(self.jsonl_path) or ".",
+                        exist_ok=True)
+            mode = "w" if self.jsonl_fresh else "a"
+            with open(self.jsonl_path, mode) as f:
+                f.write(json.dumps(
+                    {"run_start": True,
+                     "step": self.start_step}) + "\n")
+            self._jsonl_ready = True
+        # Non-finite floats are not valid JSON (bare NaN breaks strict
+        # consumers: jq, JSON.parse, ...) — map them to null.
+        safe = {k: (None if isinstance(v, float)
+                    and not math.isfinite(v) else v)
+                for k, v in entry.items()}
+        with open(self.jsonl_path, "a") as f:
+            f.write(json.dumps(safe, allow_nan=False) + "\n")
 
     def record(self, step: int, metrics: dict, epoch: int = 0) -> None:
         if not self.enabled or self.log_every <= 0:
@@ -75,7 +112,7 @@ class MetricsLogger:
             flops_per_chip = (samples_per_sec * self.flops_per_sample
                               / self.num_devices)
             entry["mfu"] = compute_mfu(flops_per_chip, self.device_kind)
-        self.history.append(entry)
+        self._append(entry)
         logger.info(
             "step %d | epoch %d | loss %.6f | %.1f samples/s/chip%s",
             step, epoch, entry["loss"], entry["samples_per_sec_per_chip"],
@@ -89,7 +126,7 @@ class MetricsLogger:
         events). Does not touch the throughput window."""
         if not self.enabled:
             return
-        self.history.append({"epoch": epoch, "step": step,
-                             name: float(value)})
+        self._append({"epoch": epoch, "step": step,
+                      name: float(value)})
         logger.info("step %d | epoch %d | %s %.6f", step, epoch, name,
                     float(value))

@@ -29,6 +29,9 @@ def test_two_process_training_run(tmp_path):
             "train.dataset_size=64",
             "train.batch_size=8",
             "train.log_every=0",
+            # exercise the COLLECTIVE consolidated export across
+            # processes (every process must enter the gather; B6).
+            "train.gather_on_save=true",
         ],
         num_processes=2,
         devices_per_process=2,
@@ -47,6 +50,13 @@ def test_two_process_training_run(tmp_path):
     # A checkpoint was written collectively.
     assert os.path.isdir(snap) and os.listdir(snap), (
         "no checkpoint written by multi-process run")
+    consolidated = [f for f in os.listdir(snap)
+                    if f.startswith("consolidated_")]
+    assert consolidated, "collective export produced no artifact"
+    from distributed_training_tpu.checkpoint import load_consolidated
+    state, meta = load_consolidated(
+        os.path.join(snap, sorted(consolidated)[-1]))
+    assert "params" in state and "step" in meta
 
 
 def test_wait_fail_fast(tmp_path):

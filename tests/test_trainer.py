@@ -159,3 +159,48 @@ def test_save_every_zero_disables_checkpointing(cpu8, tmp_path):
     trainer.train()
     assert ckpt.latest_step() is None  # nothing saved
     ckpt.close()
+
+
+def test_metrics_jsonl_stream(cpu8, tmp_path):
+    """metrics_jsonl appends one JSON line per recorded entry (loss
+    rows and unthrottled val_loss rows)."""
+    import json
+
+    from distributed_training_tpu.data import SyntheticRegressionDataset
+    from distributed_training_tpu.data.datasets import train_eval_split
+
+    cfg = Config()
+    cfg.train.total_epochs = 2
+    cfg.train.batch_size = 4
+    cfg.train.log_every = 1
+    cfg.train.eval_every = 1
+    cfg.train.metrics_jsonl = str(tmp_path / "metrics.jsonl")
+    ds = SyntheticRegressionDataset(size=96, seed=0, kind="linear")
+    train_ds, eval_ds = train_eval_split(ds, 0.25, seed=0,
+                                         multiple_of=32)
+    loader = ShardedDataLoader(train_ds, cpu8, batch_size=4,
+                               shuffle=False)
+    eval_loader = ShardedDataLoader(eval_ds, cpu8, batch_size=4,
+                                    shuffle=False)
+    model = MLP(input_size=20, output_size=1)
+    trainer = Trainer(cfg, cpu8, model, loader,
+                      eval_loader=eval_loader)
+    trainer.train()
+    lines = [json.loads(x) for x in
+             open(cfg.train.metrics_jsonl).read().splitlines()]
+    assert len(lines) >= 4
+    assert lines[0] == {"run_start": True, "step": 0}
+    assert any("loss" in e for e in lines)
+    assert any("val_loss" in e for e in lines)
+    steps = [e["step"] for e in lines]
+    assert steps == sorted(steps)
+
+    # A fresh run in the same run_dir truncates (no interleaving).
+    trainer2 = Trainer(cfg, cpu8, model, loader,
+                       eval_loader=eval_loader)
+    trainer2.metrics.record(1, {"loss": float("nan")}, epoch=0)
+    lines2 = [json.loads(x) for x in
+              open(cfg.train.metrics_jsonl).read().splitlines()]
+    assert lines2[0] == {"run_start": True, "step": 0}
+    assert len(lines2) == 2          # truncated, then one new entry
+    assert lines2[1]["loss"] is None  # NaN mapped to null, valid JSON
