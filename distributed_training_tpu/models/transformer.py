@@ -202,15 +202,31 @@ class Transformer:
 
     def _attention(self, q, k, v):
         c = self.cfg
-        if c.attention_impl == "ring":
+        if c.attention_impl in ("ring", "ulysses"):
+            if self.mesh is None:
+                raise ValueError(
+                    f"attention_impl='{c.attention_impl}' requires "
+                    "bind_mesh(mesh) before tracing (the Trainer does "
+                    "this)")
+            if c.attention_impl == "ulysses":
+                from distributed_training_tpu.parallel.ulysses import (
+                    make_ulysses_attention,
+                )
+                if self._mesh_axis_sizes().get("tp", 1) > 1:
+                    # Heads are Ulysses' shard currency; handing them
+                    # to tp as well needs a composed head axis that
+                    # isn't wired — refuse rather than silently
+                    # replicate attention over tp (ring composes: it
+                    # threads head_axis=tp).
+                    raise ValueError(
+                        "attention_impl='ulysses' does not compose "
+                        "with tp>1 yet; use attention_impl='ring'")
+                fn = make_ulysses_attention(self.mesh, causal=True)
+                return fn(q, k, v)
             from distributed_training_tpu.parallel.ring_attention import (
                 make_ring_attention,
             )
             from distributed_training_tpu.runtime import AXIS_TP
-            if self.mesh is None:
-                raise ValueError(
-                    "attention_impl='ring' requires bind_mesh(mesh) "
-                    "before tracing (the Trainer does this)")
             sizes = self._mesh_axis_sizes()
             head_ax = AXIS_TP if sizes.get(AXIS_TP, 1) > 1 else None
             fn = make_ring_attention(self.mesh, causal=True,
@@ -416,10 +432,11 @@ class Transformer:
         if pp > 1:
             # Pipeline wavefront over pp stages (parallel/pipeline.py):
             # each stage scans its local layer chunk per microbatch.
-            if c.attention_impl == "ring":
+            if c.attention_impl in ("ring", "ulysses"):
                 raise ValueError(
-                    "pipeline (pp>1) + ring attention not composable "
-                    "yet; use attention_impl='naive'/'flash'")
+                    "pipeline (pp>1) + sequence-parallel attention "
+                    f"('{c.attention_impl}') not composable yet; use "
+                    "attention_impl='naive'/'flash'")
             from distributed_training_tpu.parallel.pipeline import (
                 pipeline_apply,
             )

@@ -414,15 +414,25 @@ def make_ring_attention(mesh: Mesh, causal: bool = True,
     )
 
 
-def ring_attention_global(q: jax.Array, k: jax.Array, v: jax.Array,
-                          mesh: Mesh, causal: bool = True,
-                          batch_axes=BATCH_AXES) -> jax.Array:
-    """Convenience entry for tests/eager use. Batch axes that don't
-    divide B are dropped (replicated batch)."""
+def usable_batch_axes(mesh: Mesh, batch: int,
+                      batch_axes=BATCH_AXES) -> tuple:
+    """Mesh batch axes a global batch of ``batch`` rows can actually be
+    sharded over; axes that don't divide are dropped (replicated).
+    Shared by the eager/test entry points of every sequence-parallel
+    attention (ring, ulysses)."""
     import math
     sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
     usable = tuple(a for a in batch_axes if sizes.get(a, 1) > 1)
-    if usable and q.shape[0] % math.prod(sizes[a] for a in usable):
-        usable = ()
-    fn = make_ring_attention(mesh, causal=causal, batch_axes=usable)
+    if usable and batch % math.prod(sizes[a] for a in usable):
+        return ()
+    return usable
+
+
+def ring_attention_global(q: jax.Array, k: jax.Array, v: jax.Array,
+                          mesh: Mesh, causal: bool = True,
+                          batch_axes=BATCH_AXES) -> jax.Array:
+    """Convenience entry for tests/eager use."""
+    fn = make_ring_attention(
+        mesh, causal=causal,
+        batch_axes=usable_batch_axes(mesh, q.shape[0], batch_axes))
     return jax.jit(fn)(q, k, v)

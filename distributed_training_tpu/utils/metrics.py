@@ -64,17 +64,19 @@ class MetricsLogger:
 
     _last_time: float = field(default_factory=time.perf_counter)
     _last_step: int = 0
-    _jsonl_ready: bool = field(default=False, repr=False)
     history: list[dict] = field(default_factory=list)
 
-    def _append(self, entry: dict) -> None:
-        self.history.append(entry)
-        if not self.jsonl_path:
-            return
-        import json
-        import math
-        import os
-        if not self._jsonl_ready:
+    def __post_init__(self) -> None:
+        # Resume: the throughput window must start at the resume step,
+        # or the first row computes dsteps from 0 and reports a
+        # ~(start_step/log_every)x inflated rate into the ledger.
+        self._last_step = self.start_step
+        if self.jsonl_path and self.enabled:
+            # Eager open: a fresh run must truncate a reused run_dir's
+            # previous stream even if it crashes before the first
+            # recorded entry (stale curves misattribute silently).
+            import json
+            import os
             os.makedirs(os.path.dirname(self.jsonl_path) or ".",
                         exist_ok=True)
             mode = "w" if self.jsonl_fresh else "a"
@@ -82,7 +84,13 @@ class MetricsLogger:
                 f.write(json.dumps(
                     {"run_start": True,
                      "step": self.start_step}) + "\n")
-            self._jsonl_ready = True
+
+    def _append(self, entry: dict) -> None:
+        self.history.append(entry)
+        if not self.jsonl_path:
+            return
+        import json
+        import math
         # Non-finite floats are not valid JSON (bare NaN breaks strict
         # consumers: jq, JSON.parse, ...) — map them to null.
         safe = {k: (None if isinstance(v, float)
