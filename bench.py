@@ -27,6 +27,12 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 SEQ_LEN = 1024
 _BATCH_ENV = os.environ.get("DTT_BENCH_BATCH", "32")
+# Headline model config: "mlp" remat drops only the (B, S, 4D) MLP
+# hidden tensors — measured on the v5e as the residual class that OOMs
+# batch 16/32 (six 1.12 GiB stacked buffers); recompute is wi-matmul +
+# gelu, ~+4% step FLOPs, and it unlocks batch 32 (4x the batch-8 r2
+# config). Sweeps override via measure(..., remat=False, ...).
+HEADLINE_MODEL_KWARGS = {"remat": True, "remat_policy": "mlp"}
 WARMUP_STEPS = 3
 TIMED_STEPS = 20
 PROBE_TIMEOUT_S = int(os.environ.get("DTT_BENCH_PROBE_TIMEOUT", "120"))
@@ -138,9 +144,11 @@ def measure(batch_size: int, seq_len: int = SEQ_LEN,
     cfg.train.log_every = 0
     cfg.train.parallel_strategy = "ddp"
 
+    model_kwargs = {**HEADLINE_MODEL_KWARGS, **model_kwargs}
     phase("init_runtime")
     rt = initialize_runtime(cfg)
-    phase("build_model", batch=batch_size, seq_len=seq_len)
+    phase("build_model", batch=batch_size, seq_len=seq_len,
+          **model_kwargs)
     model = build_model("gpt2_125m", dtype="bfloat16", **model_kwargs)
     ds = SyntheticLMDataset(
         size=max(64, batch_size * rt.data_shard_count),
@@ -179,6 +187,9 @@ def measure(batch_size: int, seq_len: int = SEQ_LEN,
         "device_kind": rt.device_kind,
         "num_devices": rt.num_devices,
         "loss_finite": bool(np.isfinite(float(metrics["loss"]))),
+        # Effective (merged) kwargs — the model actually measured, so
+        # sweep rows are never confounded by the headline defaults.
+        "model_kwargs": dict(model_kwargs),
     }
 
 
@@ -199,6 +210,7 @@ def _resolve_batch() -> int:
         return 8
     key = next(k for k in HBM_GIB if k in kind)
     cfg = TransformerConfig(dtype="bfloat16",
+                            **HEADLINE_MODEL_KWARGS,
                             **PRESETS["gpt2_125m"])
     batch = 8  # floor — smallest batch the bench will attempt
     for cand in (8, 16, 32, 64, 128, 256, 512):

@@ -34,7 +34,9 @@ def main() -> None:
                         timed_steps=args.timed_steps,
                         phase=lambda *a, **k: None, **model_kwargs)
             m["mfu"] = round(m["mfu"], 4)
-            m["model_kwargs"] = model_kwargs
+            # measure() already records the EFFECTIVE model kwargs
+            # (headline defaults merged with ours) — don't overwrite
+            # with the raw CLI value.
             print(json.dumps(m), flush=True)
         except Exception as e:  # noqa: BLE001 — sweep survives OOM points
             print(json.dumps({"batch": b, "error": str(e)[:300]}),

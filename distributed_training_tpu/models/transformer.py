@@ -27,6 +27,7 @@ from __future__ import annotations
 import dataclasses
 import functools
 import math
+import warnings
 from dataclasses import dataclass
 
 import jax
@@ -58,7 +59,13 @@ class TransformerConfig:
     # only the LN/MLP intermediates (the big B*S*4D buffers) — avoids
     # re-running the flash-attention kernel under remat, which costs
     # extra Pallas launches and compiles far more slowly.
-    remat_policy: str = "selective"  # "full" | "selective"
+    # "mlp": save every D-wide block tensor (MLP_POLICY_SAVED) so the
+    # only recompute is the two (B, S, 4D) MLP hiddens — the single
+    # largest residual class (measured on a v5e: six 1.12 GiB stacked
+    # buffers at B=16, the whole OOM). Backward recompute = wi-matmul
+    # + gelu (~+11% of fwd FLOPs) — the cheapest policy that unlocks
+    # large batches.
+    remat_policy: str = "selective"  # "full" | "selective" | "mlp"
     attention_impl: str = "auto"
     # Flash-kernel tile overrides (0 → ops/flash_attention defaults);
     # exposed so the bench sweep can tune them on real hardware.
@@ -114,13 +121,13 @@ class TransformerConfig:
             raise ValueError(
                 f"unknown loss_impl '{self.loss_impl}' "
                 "(expected 'fused' or 'dense')")
-        if self.remat_policy not in ("full", "selective"):
+        if self.remat_policy not in ("full", "selective", "mlp"):
             # Validate here (not only in the remat branch of apply) so
             # a typo surfaces at construction even with remat=False or
             # on pp>1 meshes that bypass the single-stack remat path.
             raise ValueError(
                 f"unknown remat_policy '{self.remat_policy}' "
-                "(expected 'full' or 'selective')")
+                "(expected 'full', 'selective' or 'mlp')")
 
     @property
     def head_dim(self) -> int:
@@ -128,6 +135,12 @@ class TransformerConfig:
 
 
 # Reference hyperparameters for the BASELINE.json ladder. Vocab is GPT-2's
+# Allow-list for remat_policy="mlp": every D-wide tag _block emits.
+# The F-wide MLP hiddens are the only block intermediates NOT here —
+# they are the recompute this policy trades for HBM.
+MLP_POLICY_SAVED = ("ln1_out", "q_rope", "k_rope", "v_proj",
+                    "attn_out", "resid_attn", "ln2_out")
+
 # 50257 padded to 50304 (next multiple of 128): lane-aligned for the MXU
 # and divisible by any power-of-two tp axis — the standard padding trick;
 # the tokenizer never emits the padding ids.
@@ -187,11 +200,12 @@ class Transformer:
 
     def __init__(self, cfg: TransformerConfig):
         self.cfg = cfg
-        self.mesh = None  # bound by the trainer for ring attention
+        self.mesh = None  # bound by the trainer for ring/ulysses
 
     def bind_mesh(self, mesh) -> None:
-        """Give the model the device mesh (needed only when
-        ``attention_impl='ring'``: the shard_map over the ``sp`` axis is
+        """Give the model the device mesh (needed only for the
+        sequence-parallel attention impls, ``'ring'`` and
+        ``'ulysses'``: their shard_maps over the ``sp`` axis are
         constructed against a concrete mesh)."""
         self.mesh = mesh
 
@@ -212,7 +226,8 @@ class Transformer:
                 from distributed_training_tpu.parallel.ulysses import (
                     make_ulysses_attention,
                 )
-                if self._mesh_axis_sizes().get("tp", 1) > 1:
+                from distributed_training_tpu.runtime import AXIS_TP
+                if self._mesh_axis_sizes().get(AXIS_TP, 1) > 1:
                     # Heads are Ulysses' shard currency; handing them
                     # to tp as well needs a composed head axis that
                     # isn't wired — refuse rather than silently
@@ -221,12 +236,20 @@ class Transformer:
                     raise ValueError(
                         "attention_impl='ulysses' does not compose "
                         "with tp>1 yet; use attention_impl='ring'")
-                fn = make_ulysses_attention(self.mesh, causal=True)
+                fn = make_ulysses_attention(self.mesh, causal=True,
+                                            block_q=c.flash_block_q,
+                                            block_k=c.flash_block_k)
                 return fn(q, k, v)
             from distributed_training_tpu.parallel.ring_attention import (
                 make_ring_attention,
             )
             from distributed_training_tpu.runtime import AXIS_TP
+            if c.flash_block_q or c.flash_block_k:
+                warnings.warn(
+                    "flash_block_q/k overrides are not threaded "
+                    "through ring attention's custom-VJP kernels; the "
+                    "ring runs at the module default tiles",
+                    stacklevel=2)
             sizes = self._mesh_axis_sizes()
             head_ax = AXIS_TP if sizes.get(AXIS_TP, 1) > 1 else None
             fn = make_ring_attention(self.mesh, causal=True,
@@ -341,28 +364,42 @@ class Transformer:
         drop = (functools.partial(_dropout, rate=c.dropout)
                 if dropout_rng is not None else None)
 
+        # checkpoint_name tags drive the remat policies (allow-list
+        # semantics — save_only_these_names; the "anything except"
+        # combinator is defeated by aliasing: it happily saves the
+        # producing einsum's output, leaving the name a no-op).
+        # "selective" saves only attn_out; "mlp" saves every D-wide
+        # tag below and recomputes just the F-wide MLP hiddens.
+        name = jax.ad_checkpoint.checkpoint_name
+
         h = _layer_norm(x, layer["ln1"]["scale"], layer["ln1"]["bias"])
+        h = name(h, "ln1_out")
         q = jnp.einsum("bsd,dhk->bshk", h, layer["attn"]["wq"].astype(dt))
         k = jnp.einsum("bsd,dhk->bshk", h, layer["attn"]["wk"].astype(dt))
         v = jnp.einsum("bsd,dhk->bshk", h, layer["attn"]["wv"].astype(dt))
         if c.pos_encoding == "rope":
             q, k = _rope(q, k, positions)
+        # Post-rope: saving these skips both the qkv einsums and the
+        # rope rotation in backward (rope's VJP needs only cos/sin).
+        q, k, v = name(q, "q_rope"), name(k, "k_rope"), name(v, "v_proj")
         attn = self._attention(q, k, v)
-        # Named so the "selective" remat policy can pin it as saved
-        # while everything else in the block rematerializes.
-        attn = jax.ad_checkpoint.checkpoint_name(attn, "attn_out")
+        attn = name(attn, "attn_out")
         attn_proj = jnp.einsum("bshk,hkd->bsd", attn,
                                layer["attn"]["wo"].astype(dt))
         if drop is not None:
             attn_proj = drop(attn_proj,
                              rng=jax.random.fold_in(dropout_rng, 0))
-        x = x + attn_proj
+        x = name(x + attn_proj, "resid_attn")
 
         h = _layer_norm(x, layer["ln2"]["scale"], layer["ln2"]["bias"])
+        h = name(h, "ln2_out")
         if c.moe_num_experts > 0:
             mlp_out, aux = _moe_mlp(h, layer["mlp"], c)
         else:
             m = layer["mlp"]
+            # The two (B, S, 4D) tensors here are deliberately
+            # UN-named: under the "mlp" policy's allow-list they are
+            # the only recompute (wi-matmul + gelu in backward).
             u = jnp.einsum("bsd,df->bsf", h, m["wi"].astype(dt)) \
                 + m["bi"].astype(dt)
             u = jax.nn.gelu(u)
@@ -476,10 +513,16 @@ class Transformer:
                               jnp.zeros((), jnp.int32))
             if c.remat:
                 # Values validated in __post_init__; "full" → default
-                # save-nothing policy.
-                policy = (jax.checkpoint_policies.save_only_these_names(
-                    "attn_out") if c.remat_policy == "selective"
-                    else None)
+                # save-nothing policy. Allow-lists only: see the
+                # checkpoint_name comment in _block.
+                if c.remat_policy == "selective":
+                    policy = (jax.checkpoint_policies
+                              .save_only_these_names("attn_out"))
+                elif c.remat_policy == "mlp":
+                    policy = (jax.checkpoint_policies
+                              .save_only_these_names(*MLP_POLICY_SAVED))
+                else:
+                    policy = None
                 block = jax.checkpoint(block, prevent_cse=False,
                                        policy=policy)
             (x, aux), _ = jax.lax.scan(
@@ -772,6 +815,8 @@ def _moe_mlp_dense(h, mlp, c: TransformerConfig):
     topv, onehot, aux = _moe_router(h, mlp, c)
     combine = jnp.einsum("bsk,bske->bse", topv, onehot)  # (B,S,E)
     up = jnp.einsum("bsd,edf->besf", h, mlp["wi"].astype(dt))
+    # Deliberately un-named: under remat_policy="mlp"'s allow-list the
+    # (B, E, S, F) expert hiddens (E× the dense class) are recomputed.
     up = jax.nn.gelu(up)
     down = jnp.einsum("besf,efd->besd", up, mlp["wo"].astype(dt))
     out = jnp.einsum("besd,bse->bsd", down, combine.astype(dt))
@@ -837,6 +882,9 @@ def _moe_mlp_routed(h, mlp, c: TransformerConfig):
 
     expert_in = jnp.einsum("gsec,gsd->gecd", dispatch.astype(dt), x)
     up = jnp.einsum("gecd,edf->gecf", expert_in, mlp["wi"].astype(dt))
+    # Deliberately un-named: under remat_policy="mlp"'s allow-list the
+    # (G, E, C, F) expert hiddens — the routed path's biggest
+    # residuals — are recomputed in backward.
     up = jax.nn.gelu(up)
     down = jnp.einsum("gecf,efd->gecd", up, mlp["wo"].astype(dt))
     out = jnp.einsum("gsec,gecd->gsd", combine.astype(dt), down)

@@ -47,19 +47,27 @@ from distributed_training_tpu.runtime import AXIS_SP, BATCH_AXES
 
 def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                       axis_name: str = AXIS_SP, causal: bool = True,
-                      local_impl: str = "auto") -> jax.Array:
+                      local_impl: str = "auto", block_q: int = 0,
+                      block_k: int = 0) -> jax.Array:
     """Sequence-parallel attention; call INSIDE shard_map.
 
     Per-device shards: q (B, S_local, H, D); k/v (B, S_local, Hkv, D),
     the global sequence being the concatenation of shards in
     ``axis_name`` order. Output matches q's shape/dtype.
     ``local_impl`` feeds ops.dot_product_attention for the full-sequence
-    local attention ("auto" → Pallas flash on TPU).
+    local attention ("auto" → Pallas flash on TPU); ``block_q``/
+    ``block_k`` are the flash tile overrides (0 → kernel defaults),
+    threaded so the bench sweep tunes the single-device and Ulysses
+    layouts with one knob. (The ring layout is the exception: its
+    per-block kernels always run at the module defaults — the
+    overrides don't reach through its custom-VJP machinery, and the
+    model warns if you set them together.)
     """
     sp = jax.lax.axis_size(axis_name)
     if sp == 1:
         return dot_product_attention(q, k, v, causal=causal,
-                                     impl=local_impl)
+                                     impl=local_impl, block_q=block_q,
+                                     block_k=block_k)
     H, Hkv = q.shape[2], k.shape[2]
     if H % sp or Hkv % sp:
         raise ValueError(
@@ -78,13 +86,15 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 
     out = dot_product_attention(
         seq_to_heads(q), seq_to_heads(k), seq_to_heads(v),
-        causal=causal, impl=local_impl)
+        causal=causal, impl=local_impl, block_q=block_q,
+        block_k=block_k)
     return heads_to_seq(out)
 
 
 def make_ulysses_attention(mesh: Mesh, causal: bool = True,
                            batch_axes=BATCH_AXES,
-                           local_impl: str = "auto"):
+                           local_impl: str = "auto", block_q: int = 0,
+                           block_k: int = 0):
     """Build the shard_map'd Ulysses fn over global (B, S, H, D)
     arrays: batch over ``batch_axes``, sequence over ``sp``. Mirrors
     make_ring_attention's contract (the model picks by
@@ -92,7 +102,8 @@ def make_ulysses_attention(mesh: Mesh, causal: bool = True,
     spec = P(tuple(batch_axes) or None, AXIS_SP, None, None)
     return shard_map(
         functools.partial(ulysses_attention, axis_name=AXIS_SP,
-                          causal=causal, local_impl=local_impl),
+                          causal=causal, local_impl=local_impl,
+                          block_q=block_q, block_k=block_k),
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,

@@ -20,6 +20,10 @@ import numpy as np
 
 _BYTES = {"float32": 4, "bfloat16": 2, "float16": 2}
 
+# Concurrent-copies multiplier on per-layer scan residuals, calibrated
+# on a v5e OOM report (see estimate_transformer_memory docstring).
+_SCAN_RESIDUAL_OVERHEAD = 2.0
+
 # Known per-chip HBM capacities (GiB) for planning output.
 HBM_GIB = {
     "v4": 32.0,
@@ -65,13 +69,18 @@ def estimate_transformer_memory(
     - params/grads: n_params × dtype bytes, sharded over fsdp×tp;
     - optimizer: AdamW = two fp32 moments (+ fp32 master view is not
       kept — params are the master copy), SGD = none;
-    - activations (per layer, batch B, seq S, width D, ffn F):
-        no remat:      residual + ln + qkv + attn-out + mlp-in + gelu
-                       ≈ (6·D + 2·F) · B·S · bytes
-        remat full:    only the inter-layer residual survives the scan
-                       ≈ 2·D · B·S · bytes (carry + saved input)
-        remat selective: residual + saved attention output
-                       ≈ 3·D · B·S · bytes
+    - activations (per layer, batch B, seq S, width D, ffn F),
+      as (saved tensors) × ``_SCAN_RESIDUAL_OVERHEAD`` — a v5e OOM
+      report showed the allocator holding ~2× each scan-residual stack
+      concurrently (fwd stacking + bwd consumption don't share), e.g.
+      six live 1.12 GiB [L,B,S,F] buffers at B=16 where the naive
+      count says two. Applied to every policy's saved set:
+        no remat:      (6·D + 4·F) saved → ×2
+        remat mlp:     everything except the two F-wide MLP tensors,
+                       ≈ 8·D saved → ×2
+        remat selective: residual + saved attention output,
+                       ≈ 3·D saved → ×2
+        remat full:    carry + saved input, ≈ 2·D saved → ×2
       plus the loss head: with ``loss_impl='dense'`` the B·S·V fp32
       logits buffer (often the true peak); with the default fused
       chunked xent (ops/xent.py) only a chunk_rows·V fp32 tile plus the
@@ -104,12 +113,14 @@ def estimate_transformer_memory(
 
     B, S, D, F = batch_per_chip, seq_len, c.d_model, d_ff
     if not c.remat:
-        act_per_layer = (6 * D + 2 * F) * B * S * ab
+        act_per_layer = (6 * D + 4 * F) * B * S * ab
     elif c.remat_policy == "selective":
         act_per_layer = 3 * D * B * S * ab
+    elif c.remat_policy == "mlp":
+        act_per_layer = 8 * D * B * S * ab
     else:  # full
         act_per_layer = 2 * D * B * S * ab
-    acts_b = c.n_layers * act_per_layer
+    acts_b = c.n_layers * act_per_layer * _SCAN_RESIDUAL_OVERHEAD
     if getattr(c, "loss_impl", "fused") == "dense":
         # fp32 logits + their softmax residual dominate.
         acts_b += B * S * c.vocab_size * 4 / max(1, tp)

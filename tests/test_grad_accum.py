@@ -84,7 +84,7 @@ def test_remat_policies_preserve_loss_and_grads():
         return jax.jit(jax.value_and_grad(f))(params)
 
     ref_loss, ref_grads = loss_of(ref_model)
-    for policy in ("full", "selective"):
+    for policy in ("full", "selective", "mlp"):
         loss, grads = loss_of(tiny_tf(remat=True, policy=policy))
         np.testing.assert_allclose(float(loss), float(ref_loss),
                                    rtol=1e-6)
@@ -99,3 +99,61 @@ def test_remat_unknown_policy_raises():
         model = tiny_tf(remat=True, policy="bogus")
         params = model.init(jax.random.PRNGKey(0))
         model.apply(params, jnp.zeros((1, 8), jnp.int32))
+
+
+def test_remat_mlp_policy_covers_moe():
+    """remat_policy='mlp' must (a) leave loss/grads exactly equal to
+    the non-remat model and (b) actually SAVE FEWER residual bytes —
+    the structural half catches the failure numerics cannot: a policy
+    that silently saves everything (e.g. the aliasing-defeated
+    save_anything_except_these_names this repo abandoned) is
+    numerically identical but retains every F-wide expert hidden
+    (the OOM class the policy exists to drop)."""
+    def moe_tf(remat):
+        return Transformer(TransformerConfig(
+            vocab_size=128, d_model=32, n_layers=2, n_heads=4,
+            max_seq_len=16, dtype="float32", param_dtype="float32",
+            moe_num_experts=4, moe_top_k=2, attention_impl="naive",
+            remat=remat, remat_policy="mlp"))
+
+    tokens = jnp.asarray(
+        np.random.default_rng(1).integers(0, 128, (2, 16)), jnp.int32)
+    batch = {"tokens": tokens}
+    ref_model = moe_tf(remat=False)
+    params = ref_model.init(jax.random.PRNGKey(0))
+    rng = jax.random.PRNGKey(1)
+
+    def loss_of(model):
+        def f(p):
+            loss, _ = model.loss(p, batch, rng)
+            return loss
+        return jax.jit(jax.value_and_grad(f))(params)
+
+    ref_loss, ref_grads = loss_of(ref_model)
+    loss, grads = loss_of(moe_tf(remat=True))
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-6)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6),
+        grads, ref_grads)
+
+    try:  # public in newer jax; private in the pinned version
+        from jax.ad_checkpoint import saved_residuals
+    except ImportError:
+        from jax._src.ad_checkpoint import saved_residuals
+
+    def residual_bytes(model):
+        def f(p):
+            loss, _ = model.loss(p, batch, rng)
+            return loss
+        return sum(
+            int(np.prod(aval.shape)) * aval.dtype.itemsize
+            for aval, _ in saved_residuals(f, params)
+            if hasattr(aval, "shape") and aval.shape)
+
+    saved_no_remat = residual_bytes(ref_model)
+    saved_mlp = residual_bytes(moe_tf(remat=True))
+    assert saved_mlp < saved_no_remat, (
+        f"remat_policy='mlp' saved {saved_mlp} residual bytes vs "
+        f"{saved_no_remat} without remat — the policy is a no-op "
+        "(checkpoint_name tags missing from the MoE MLP?)")

@@ -33,10 +33,12 @@ def test_remat_reduces_activations():
             cfg(remat=remat, remat_policy=pol), 8, 64).activations_gib
         for name, remat, pol in (
             ("none", False, "full"),
+            ("mlp", True, "mlp"),
             ("selective", True, "selective"),
             ("full", True, "full"))
     }
-    assert ests["none"] > ests["selective"] > ests["full"]
+    assert (ests["none"] > ests["mlp"] > ests["selective"]
+            > ests["full"])
 
 
 def test_sharding_divides_state():
