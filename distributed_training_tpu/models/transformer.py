@@ -134,16 +134,16 @@ class TransformerConfig:
         return self.d_model // self.n_heads
 
 
-# Reference hyperparameters for the BASELINE.json ladder. Vocab is GPT-2's
 # Allow-list for remat_policy="mlp": every D-wide tag _block emits.
 # The F-wide MLP hiddens are the only block intermediates NOT here —
 # they are the recompute this policy trades for HBM.
 MLP_POLICY_SAVED = ("ln1_out", "q_rope", "k_rope", "v_proj",
                     "attn_out", "resid_attn", "ln2_out")
 
-# 50257 padded to 50304 (next multiple of 128): lane-aligned for the MXU
-# and divisible by any power-of-two tp axis — the standard padding trick;
-# the tokenizer never emits the padding ids.
+# Reference hyperparameters for the BASELINE.json ladder. Vocab is
+# GPT-2's 50257 padded to 50304 (next multiple of 128): lane-aligned
+# for the MXU and divisible by any power-of-two tp axis — the standard
+# padding trick; the tokenizer never emits the padding ids.
 PRESETS: dict[str, dict] = {
     "gpt2_125m": dict(vocab_size=50304, d_model=768, n_layers=12,
                       n_heads=12, max_seq_len=1024),
@@ -226,19 +226,27 @@ class Transformer:
                 from distributed_training_tpu.parallel.ulysses import (
                     make_ulysses_attention,
                 )
-                from distributed_training_tpu.runtime import AXIS_TP
-                if self._mesh_axis_sizes().get(AXIS_TP, 1) > 1:
-                    # Heads are Ulysses' shard currency; handing them
-                    # to tp as well needs a composed head axis that
-                    # isn't wired — refuse rather than silently
-                    # replicate attention over tp (ring composes: it
-                    # threads head_axis=tp).
+                from distributed_training_tpu.runtime import (
+                    AXIS_SP, AXIS_TP)
+                sizes = self._mesh_axis_sizes()
+                tp = sizes.get(AXIS_TP, 1)
+                sp = sizes.get(AXIS_SP, 1)
+                if c.n_kv_heads % (tp * sp) or c.n_heads % (tp * sp):
+                    # Heads are the shard currency for BOTH tp and the
+                    # Ulysses a2a — refuse up front with global counts
+                    # (the in-shard_map check would report per-shard
+                    # numbers).
                     raise ValueError(
-                        "attention_impl='ulysses' does not compose "
-                        "with tp>1 yet; use attention_impl='ring'")
+                        f"attention_impl='ulysses' on tp={tp}, "
+                        f"sp={sp} needs n_heads ({c.n_heads}) and "
+                        f"n_kv_heads ({c.n_kv_heads}) divisible by "
+                        "tp*sp; use attention_impl='ring' (no head "
+                        "constraint)")
+                head_ax = AXIS_TP if tp > 1 else None
                 fn = make_ulysses_attention(self.mesh, causal=True,
                                             block_q=c.flash_block_q,
-                                            block_k=c.flash_block_k)
+                                            block_k=c.flash_block_k,
+                                            head_axis=head_ax)
                 return fn(q, k, v)
             from distributed_training_tpu.parallel.ring_attention import (
                 make_ring_attention,

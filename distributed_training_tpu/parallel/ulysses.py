@@ -68,11 +68,16 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         return dot_product_attention(q, k, v, causal=causal,
                                      impl=local_impl, block_q=block_q,
                                      block_k=block_k)
+    # Shapes here are per-shard: when a head axis (tp) also shards the
+    # head dim, these are the per-tp-shard counts — which is exactly
+    # what must divide by sp (the a2a swaps seq for heads within the
+    # local head group, so tp composition falls out for free).
     H, Hkv = q.shape[2], k.shape[2]
     if H % sp or Hkv % sp:
         raise ValueError(
-            f"ulysses needs n_heads ({H}) and n_kv_heads ({Hkv}) "
-            f"divisible by sp ({sp}); use ring attention otherwise")
+            f"ulysses needs the per-shard head counts (q: {H}, "
+            f"kv: {Hkv}) divisible by sp ({sp}); use ring attention "
+            "otherwise")
 
     def seq_to_heads(x):
         # (B, S/sp, h, D) -> (B, S, h/sp, D)
@@ -94,12 +99,15 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 def make_ulysses_attention(mesh: Mesh, causal: bool = True,
                            batch_axes=BATCH_AXES,
                            local_impl: str = "auto", block_q: int = 0,
-                           block_k: int = 0):
+                           block_k: int = 0, head_axis=None):
     """Build the shard_map'd Ulysses fn over global (B, S, H, D)
-    arrays: batch over ``batch_axes``, sequence over ``sp``. Mirrors
+    arrays: batch over ``batch_axes``, sequence over ``sp``, heads
+    over ``head_axis`` (tp) when given — the a2a then trades sequence
+    for heads within each tp shard's head group, so tp and sp compose
+    (requires H and Hkv divisible by tp·sp). Mirrors
     make_ring_attention's contract (the model picks by
     ``attention_impl``)."""
-    spec = P(tuple(batch_axes) or None, AXIS_SP, None, None)
+    spec = P(tuple(batch_axes) or None, AXIS_SP, head_axis, None)
     return shard_map(
         functools.partial(ulysses_attention, axis_name=AXIS_SP,
                           causal=causal, local_impl=local_impl,
@@ -113,7 +121,8 @@ def make_ulysses_attention(mesh: Mesh, causal: bool = True,
 
 def ulysses_attention_global(q: jax.Array, k: jax.Array, v: jax.Array,
                              mesh: Mesh, causal: bool = True,
-                             batch_axes=BATCH_AXES) -> jax.Array:
+                             batch_axes=BATCH_AXES,
+                             head_axis=None) -> jax.Array:
     """Convenience entry for tests/eager use (mirrors
     ring_attention_global)."""
     from distributed_training_tpu.parallel.ring_attention import (
@@ -121,5 +130,6 @@ def ulysses_attention_global(q: jax.Array, k: jax.Array, v: jax.Array,
     )
     fn = make_ulysses_attention(
         mesh, causal=causal,
-        batch_axes=usable_batch_axes(mesh, q.shape[0], batch_axes))
+        batch_axes=usable_batch_axes(mesh, q.shape[0], batch_axes),
+        head_axis=head_axis)
     return jax.jit(fn)(q, k, v)

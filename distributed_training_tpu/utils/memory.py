@@ -69,18 +69,21 @@ def estimate_transformer_memory(
     - params/grads: n_params × dtype bytes, sharded over fsdp×tp;
     - optimizer: AdamW = two fp32 moments (+ fp32 master view is not
       kept — params are the master copy), SGD = none;
-    - activations (per layer, batch B, seq S, width D, ffn F),
-      as (saved tensors) × ``_SCAN_RESIDUAL_OVERHEAD`` — a v5e OOM
-      report showed the allocator holding ~2× each scan-residual stack
-      concurrently (fwd stacking + bwd consumption don't share), e.g.
-      six live 1.12 GiB [L,B,S,F] buffers at B=16 where the naive
-      count says two. Applied to every policy's saved set:
-        no remat:      (6·D + 4·F) saved → ×2
-        remat mlp:     everything except the two F-wide MLP tensors,
-                       ≈ 8·D saved → ×2
-        remat selective: residual + saved attention output,
-                       ≈ 3·D saved → ×2
-        remat full:    carry + saved input, ≈ 2·D saved → ×2
+    - activations (per layer, batch B, seq S, width D, ffn F), as
+      (saved-set coefficient) × ``_SCAN_RESIDUAL_OVERHEAD``. The two
+      knobs encode ONE measurement jointly and must be recalibrated
+      together: a v5e OOM report at B=16 (no remat) showed six live
+      1.12 GiB [L,B,S,F] buffers — 3× the two logical F-wide saves,
+      plus further D-wide copies below the report's top-20. The model
+      here is: saved-set coefficients count logical saves ×2 for
+      XLA's forward temporaries (F term: 2·F → 4·F), and the global
+      ×2 overhead covers fwd-stack/bwd-consumption concurrency —
+      jointly 8·F vs the ≥6·F observed live at peak, one notch
+      conservative. Per policy (saved set before the global ×2):
+        no remat:        6·D + 4·F
+        remat mlp:       ≈ 8·D (everything but the F-wide MLP pair)
+        remat selective: ≈ 3·D (residual + attention output)
+        remat full:      ≈ 2·D (carry + saved input)
       plus the loss head: with ``loss_impl='dense'`` the B·S·V fp32
       logits buffer (often the true peak); with the default fused
       chunked xent (ops/xent.py) only a chunk_rows·V fp32 tile plus the

@@ -105,18 +105,65 @@ def test_ulysses_training_end_to_end_matches_dp():
                                rtol=1e-5, atol=1e-6)
 
 
-def test_ulysses_rejects_tp():
-    """tp>1 would be silently defeated (heads are Ulysses' shard
-    currency) — the model must refuse, mirroring the pp>1 guard."""
+def test_ulysses_tp_composition_matches_full():
+    """Heads sharded over tp AND traded for sequence by the sp a2a:
+    the composed layout must still be exact (needs H, Hkv % tp*sp)."""
+    rt = fake_cpu_runtime(8, sp=2, tp=2)
+    q, k, v = rand_qkv(H=8, Hkv=4)
+    out = ulysses_attention_global(q, k, v, rt.mesh, causal=True,
+                                   head_axis="tp")
+    ref = _naive_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ulysses_tp_training_end_to_end_matches_dp():
+    """Train-step losses with attention_impl=ulysses on a
+    (dp=2, sp=2, tp=2) mesh == naive attention on a plain dp=2 mesh."""
+    from distributed_training_tpu.config import Config
+    from distributed_training_tpu.data import (ShardedDataLoader,
+                                               SyntheticLMDataset)
+    from distributed_training_tpu.models.transformer import (
+        Transformer, TransformerConfig)
+    from distributed_training_tpu.train.trainer import Trainer
+
+    losses = {}
+    for tag, ndev, axes, impl in (
+            ("dp", 2, {}, "naive"),
+            ("tp_sp", 8, {"sp": 2, "tp": 2}, "ulysses")):
+        rt = fake_cpu_runtime(ndev, **axes)
+        assert rt.data_shard_count == 2
+        cfg = Config()
+        cfg.train.batch_size = 2
+        cfg.train.total_epochs = 1
+        cfg.train.log_every = 0
+        cfg.train.learning_rate = 0.01
+        model = Transformer(TransformerConfig(
+            vocab_size=64, d_model=32, n_layers=2, n_heads=4,
+            max_seq_len=16, dtype="float32", attention_impl=impl))
+        ds = SyntheticLMDataset(size=8, seq_len=16, vocab_size=64,
+                                seed=0)
+        loader = ShardedDataLoader(ds, rt, batch_size=2, shuffle=False)
+        trainer = Trainer(cfg, rt, model, loader)
+        losses[tag] = [float(trainer.train_step(b)["loss"])
+                       for b in loader.epoch(0)]
+    np.testing.assert_allclose(losses["dp"], losses["tp_sp"],
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_ulysses_tp_rejects_indivisible_heads():
+    """tp*sp exceeding the kv-head count must fail loudly, with
+    GLOBAL head counts in the message (the in-shard_map check would
+    report confusing per-shard numbers)."""
     from distributed_training_tpu.models.transformer import (
         Transformer, TransformerConfig)
     rt = fake_cpu_runtime(8, sp=2, tp=2)
     model = Transformer(TransformerConfig(
-        vocab_size=64, d_model=32, n_layers=1, n_heads=4,
+        vocab_size=64, d_model=32, n_layers=1, n_heads=4, n_kv_heads=2,
         max_seq_len=16, dtype="float32", attention_impl="ulysses"))
     model.bind_mesh(rt.mesh)
     params = jax.jit(model.init)(jax.random.PRNGKey(0))
     tokens = jnp.zeros((2, 9), jnp.int32)
-    with pytest.raises(ValueError, match="ulysses"):
+    with pytest.raises(ValueError, match="tp\\*sp"):
         jax.jit(lambda p, b: model.loss(p, b, jax.random.PRNGKey(0)))(
             params, {"tokens": tokens})
