@@ -71,6 +71,12 @@ class TransformerConfig:
     # exposed so the bench sweep can tune them on real hardware.
     flash_block_q: int = 0
     flash_block_k: int = 0
+    # lax.scan unroll over layers (1 = no unroll). Unrolling lets XLA
+    # schedule/fuse across layer boundaries and shrink scan-stack
+    # copies at the cost of compile time; a bench-sweep knob, numerics
+    # are unchanged. Must divide n_layers (lax.scan requirement is
+    # looser, but a ragged tail recompiles the remainder block).
+    scan_unroll: int = 1
     pp_microbatches: int = 4      # microbatches when mesh pp > 1
     pp_schedule: str = "gpipe"    # "gpipe" | "interleaved"
     pp_virtual_stages: int = 2    # chunks/device when interleaved
@@ -121,6 +127,10 @@ class TransformerConfig:
             raise ValueError(
                 f"unknown loss_impl '{self.loss_impl}' "
                 "(expected 'fused' or 'dense')")
+        if self.scan_unroll < 1 or self.n_layers % self.scan_unroll:
+            raise ValueError(
+                f"scan_unroll ({self.scan_unroll}) must be >= 1 and "
+                f"divide n_layers ({self.n_layers})")
         if self.remat_policy not in ("full", "selective", "mlp"):
             # Validate here (not only in the remat branch of apply) so
             # a typo surfaces at construction even with remat=False or
@@ -201,6 +211,10 @@ class Transformer:
     def __init__(self, cfg: TransformerConfig):
         self.cfg = cfg
         self.mesh = None  # bound by the trainer for ring/ulysses
+        # True while tracing the pipeline stage body (every mesh axis
+        # is already manual there — _attention must not open a nested
+        # shard_map).
+        self._inside_pp = False
 
     def bind_mesh(self, mesh) -> None:
         """Give the model the device mesh (needed only for the
@@ -224,13 +238,30 @@ class Transformer:
                     "this)")
             if c.attention_impl == "ulysses":
                 from distributed_training_tpu.parallel.ulysses import (
-                    make_ulysses_attention,
+                    make_ulysses_attention, ulysses_attention,
                 )
                 from distributed_training_tpu.runtime import (
                     AXIS_SP, AXIS_TP)
                 sizes = self._mesh_axis_sizes()
                 tp = sizes.get(AXIS_TP, 1)
                 sp = sizes.get(AXIS_SP, 1)
+                if self._inside_pp:
+                    # Already inside the pipeline's shard_map (every
+                    # mesh axis is manual there): call the collective-
+                    # level fn directly — a nested shard_map would
+                    # throw. Stage params are replicated over tp
+                    # (pipeline_spec), so heads arrive whole and only
+                    # sp divides them.
+                    if c.n_kv_heads % sp or c.n_heads % sp:
+                        raise ValueError(
+                            f"attention_impl='ulysses' under pp with "
+                            f"sp={sp} needs n_heads ({c.n_heads}) and "
+                            f"n_kv_heads ({c.n_kv_heads}) divisible "
+                            "by sp")
+                    return ulysses_attention(
+                        q, k, v, axis_name=AXIS_SP, causal=True,
+                        block_q=c.flash_block_q,
+                        block_k=c.flash_block_k)
                 if c.n_kv_heads % (tp * sp) or c.n_heads % (tp * sp):
                     # Heads are the shard currency for BOTH tp and the
                     # Ulysses a2a — refuse up front with global counts
@@ -457,7 +488,9 @@ class Transformer:
         # pp=1 draws (tested in tests/test_pipeline.py).
         rng7 = jax.random.fold_in(rng, 7) if dropping else None
 
-        def body_with(mb_idx, shard_idx):
+        def body_with(mb_idx, shard_idx, pos=None):
+            pos = positions if pos is None else pos
+
             def body(carry, inp):
                 layer, lid = inp
                 x, aux = carry
@@ -467,7 +500,7 @@ class Transformer:
                         jax.random.fold_in(
                             jax.random.fold_in(rng7, lid), mb_idx),
                         shard_idx)
-                x, layer_aux = self._block(x, layer, positions,
+                x, layer_aux = self._block(x, layer, pos,
                                            dropout_rng=lrng)
                 return (x, aux + layer_aux), None
             return body
@@ -477,16 +510,23 @@ class Transformer:
         if pp > 1:
             # Pipeline wavefront over pp stages (parallel/pipeline.py):
             # each stage scans its local layer chunk per microbatch.
-            if c.attention_impl in ("ring", "ulysses"):
+            # Ulysses composes (the stage body calls the collective-
+            # level a2a attention directly — see _attention); the ring
+            # does not: its reverse-ring custom VJP inside the
+            # checkpointed pipeline tick is unwired.
+            if c.attention_impl == "ring":
                 raise ValueError(
-                    "pipeline (pp>1) + sequence-parallel attention "
-                    f"('{c.attention_impl}') not composable yet; use "
-                    "attention_impl='naive'/'flash'")
+                    "pipeline (pp>1) + attention_impl='ring' not "
+                    "composable yet; use attention_impl='ulysses' "
+                    "(or 'naive'/'flash')")
             from distributed_training_tpu.parallel.pipeline import (
                 pipeline_apply,
             )
-            from distributed_training_tpu.runtime import BATCH_AXES
+            from distributed_training_tpu.runtime import (
+                AXIS_SP, BATCH_AXES)
 
+            sp = self._mesh_axis_sizes().get(AXIS_SP, 1)
+            seq_parallel = c.attention_impl == "ulysses" and sp > 1
             batch_ax = tuple(
                 a for a in BATCH_AXES
                 if self._mesh_axis_sizes().get(a, 1) > 1)
@@ -494,8 +534,21 @@ class Transformer:
             def stage_body(stage_params, layer_ids, xb, mb_idx):
                 shard_idx = (jax.lax.axis_index(batch_ax) if batch_ax
                              else jnp.zeros((), jnp.int32))
+                pos = None
+                if seq_parallel:
+                    # Fold the sp position in too: each sp member
+                    # holds a different sequence slice, and without
+                    # this term they would all draw the SAME local
+                    # dropout mask (correlated dropout along S).
+                    shard_idx = (shard_idx * sp
+                                 + jax.lax.axis_index(AXIS_SP))
+                    # And offset positions to the shard's slice of the
+                    # global sequence (rope must see global indices).
+                    s_loc = xb.shape[1]
+                    pos = (jax.lax.axis_index(AXIS_SP) * s_loc
+                           + jnp.arange(s_loc))
                 (xb, aux), _ = jax.lax.scan(
-                    body_with(mb_idx, shard_idx),
+                    body_with(mb_idx, shard_idx, pos=pos),
                     (xb, jnp.zeros((), jnp.float32)),
                     (stage_params, layer_ids))
                 return xb, aux
@@ -507,11 +560,16 @@ class Transformer:
                 self._mesh_axis_sizes().get(a, 1) for a in BATCH_AXES)
             M = max(m for m in range(1, min(c.pp_microbatches, B) + 1)
                     if B % m == 0 and (B // m) % shards == 0)
-            x, aux = pipeline_apply(
-                stage_body, stacked, x, self.mesh,
-                num_microbatches=M, batch_axes=BATCH_AXES,
-                schedule=c.pp_schedule,
-                virtual_stages=c.pp_virtual_stages)
+            self._inside_pp = True
+            try:
+                x, aux = pipeline_apply(
+                    stage_body, stacked, x, self.mesh,
+                    num_microbatches=M, batch_axes=BATCH_AXES,
+                    schedule=c.pp_schedule,
+                    virtual_stages=c.pp_virtual_stages,
+                    seq_axis=AXIS_SP if seq_parallel else None)
+            finally:
+                self._inside_pp = False
             # aux is an intensive (batch-mean) statistic summed over M
             # microbatches — renormalize so pp meshes optimize the same
             # objective as non-pp meshes.
@@ -535,7 +593,7 @@ class Transformer:
                                        policy=policy)
             (x, aux), _ = jax.lax.scan(
                 block, (x, jnp.zeros((), jnp.float32)),
-                (stacked, layer_ids_all))
+                (stacked, layer_ids_all), unroll=c.scan_unroll)
         aux = aux / c.n_layers  # mean load-balancing loss over layers
 
         x = _layer_norm(x, params["final_norm"]["scale"],

@@ -110,8 +110,10 @@ def _gpipe(stage_params, layer_ids, x_mb, aux0, *, body_fn,
            num_microbatches, axis_name):
     """GPipe wavefront inside shard_map. stage_params leaves:
     (L/pp, ...) local shard; layer_ids: (L/pp,) global layer ids;
-    x_mb: (M, B_mb, S, D) microbatched activations (replicated across
-    pp); returns processed (M, B_mb, S, D) + summed aux."""
+    x_mb: (M, B_mb, S_local, D) microbatched activations — replicated
+    across pp; S_local = S/sp when ``pipeline_apply`` got a
+    ``seq_axis`` (the stage body then holds only its sequence slice).
+    Returns processed (M, B_mb, S_local, D) + summed aux."""
     pp = jax.lax.axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     M = num_microbatches
@@ -174,7 +176,9 @@ def _interleaved(stage_params, layer_ids, x_mb, aux0, *, body_fn,
     [c·Lc, (c+1)·Lc), pre-permuted by the caller so chunk c is virtual
     stage ``c·pp + d``). Each tick applies ONE chunk, selected by
     ``lax.switch`` on the static schedule table, so a tick costs
-    1/v of a GPipe tick and the fill bubble shrinks v-fold."""
+    1/v of a GPipe tick and the fill bubble shrinks v-fold.
+    x_mb's sequence dim is local (S/sp) when ``pipeline_apply`` got a
+    ``seq_axis`` — same contract as ``_gpipe``."""
     pp = jax.lax.axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     M = num_microbatches
@@ -250,7 +254,8 @@ def interleave_layer_order(L: int, pp: int, v: int) -> np.ndarray:
 def pipeline_apply(body_fn: Callable, stacked_params, x: jax.Array,
                    mesh: Mesh, num_microbatches: int,
                    batch_axes=(), axis_name: str = AXIS_PP,
-                   schedule: str = "gpipe", virtual_stages: int = 2):
+                   schedule: str = "gpipe", virtual_stages: int = 2,
+                   seq_axis=None):
     """Apply ``body_fn`` (one stage-chunk's layers over one microbatch:
     ``(stage_params, layer_ids, x, mb_idx) -> (x, aux)``) as a pipeline.
 
@@ -262,6 +267,10 @@ def pipeline_apply(body_fn: Callable, stacked_params, x: jax.Array,
     ``schedule``: "gpipe", or "interleaved" with ``virtual_stages``
     chunks per device (requires L % (v·pp) == 0; costs one stacked-param
     gather per step to place chunks into device storage order).
+    ``seq_axis``: mesh axis sharding the sequence dim of ``x`` (sp, for
+    Ulysses attention inside the stage body); activations stay
+    sequence-sharded as they rotate through stages — the pp ppermute
+    moves each (pp, sp) shard to its pp-neighbor with the same sp index.
     Returns ``(x_out, aux_sum)`` with x_out shaped like x.
     """
     if schedule not in SCHEDULES:
@@ -303,7 +312,7 @@ def pipeline_apply(body_fn: Callable, stacked_params, x: jax.Array,
 
     param_specs = jax.tree.map(
         lambda leaf: pipeline_spec(leaf.ndim), stacked_params)
-    xspec = P(None, tuple(batch_axes) or None, None, None)
+    xspec = P(None, tuple(batch_axes) or None, seq_axis, None)
     x_mb = jax.lax.with_sharding_constraint(
         x_mb, NamedSharding(mesh, xspec))
 
