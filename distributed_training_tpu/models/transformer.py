@@ -280,15 +280,24 @@ class Transformer:
                                             head_axis=head_ax)
                 return fn(q, k, v)
             from distributed_training_tpu.parallel.ring_attention import (
-                make_ring_attention,
+                make_ring_attention, ring_attention,
             )
-            from distributed_training_tpu.runtime import AXIS_TP
+            from distributed_training_tpu.runtime import (
+                AXIS_SP, AXIS_TP)
             if c.flash_block_q or c.flash_block_k:
                 warnings.warn(
                     "flash_block_q/k overrides are not threaded "
                     "through ring attention's custom-VJP kernels; the "
                     "ring runs at the module default tiles",
                     stacklevel=2)
+            if self._inside_pp:
+                # Same pattern as the Ulysses branch: inside the
+                # pipeline's shard_map the sp axis is already manual,
+                # so call the collective-level ring directly (stage
+                # params are replicated over tp there, so no head
+                # axis applies).
+                return ring_attention(q, k, v, axis_name=AXIS_SP,
+                                      causal=True)
             sizes = self._mesh_axis_sizes()
             head_ax = AXIS_TP if sizes.get(AXIS_TP, 1) > 1 else None
             fn = make_ring_attention(self.mesh, causal=True,
@@ -485,7 +494,13 @@ class Transformer:
         # so without the shard term every dp/fsdp shard would draw the
         # SAME mask — correlated dropout across data shards). pp=N with
         # one microbatch and one data shard draws exactly the masks
-        # pp=1 draws (tested in tests/test_pipeline.py).
+        # pp=1 draws (tested in tests/test_pipeline.py). Carve-out:
+        # under pp>1 WITH sp>1 the sp index is folded in too (each sp
+        # member holds a sequence slice and draws its own local mask),
+        # so masks are decorrelated along S but do NOT bit-match the
+        # pp=1 global draw — same objective in distribution, different
+        # realization; cross-layout trajectory parity with dropout>0
+        # holds only at sp=1.
         rng7 = jax.random.fold_in(rng, 7) if dropping else None
 
         def body_with(mb_idx, shard_idx, pos=None):
@@ -510,15 +525,10 @@ class Transformer:
         if pp > 1:
             # Pipeline wavefront over pp stages (parallel/pipeline.py):
             # each stage scans its local layer chunk per microbatch.
-            # Ulysses composes (the stage body calls the collective-
-            # level a2a attention directly — see _attention); the ring
-            # does not: its reverse-ring custom VJP inside the
-            # checkpointed pipeline tick is unwired.
-            if c.attention_impl == "ring":
-                raise ValueError(
-                    "pipeline (pp>1) + attention_impl='ring' not "
-                    "composable yet; use attention_impl='ulysses' "
-                    "(or 'naive'/'flash')")
+            # Both sequence-parallel impls compose: the stage body
+            # calls the collective-level attention directly — see
+            # _attention (inside the pipeline shard_map every mesh
+            # axis is manual; a nested shard_map would throw).
             from distributed_training_tpu.parallel.pipeline import (
                 pipeline_apply,
             )
@@ -526,7 +536,8 @@ class Transformer:
                 AXIS_SP, BATCH_AXES)
 
             sp = self._mesh_axis_sizes().get(AXIS_SP, 1)
-            seq_parallel = c.attention_impl == "ulysses" and sp > 1
+            seq_parallel = (c.attention_impl in ("ring", "ulysses")
+                            and sp > 1)
             batch_ax = tuple(
                 a for a in BATCH_AXES
                 if self._mesh_axis_sizes().get(a, 1) > 1)
@@ -547,10 +558,22 @@ class Transformer:
                     s_loc = xb.shape[1]
                     pos = (jax.lax.axis_index(AXIS_SP) * s_loc
                            + jnp.arange(s_loc))
+                # The sweep's scan_unroll knob applies here too; the
+                # stage's local layer count (L/pp, or L/(v*pp) per
+                # interleaved chunk) must divide it, else fall back
+                # loudly rather than silently ignoring the knob.
+                l_local = jax.tree.leaves(stage_params)[0].shape[0]
+                unroll = c.scan_unroll
+                if unroll > 1 and l_local % unroll:
+                    warnings.warn(
+                        f"scan_unroll={unroll} does not divide the "
+                        f"pipeline stage's {l_local} local layers; "
+                        "using unroll=1", stacklevel=2)
+                    unroll = 1
                 (xb, aux), _ = jax.lax.scan(
                     body_with(mb_idx, shard_idx, pos=pos),
                     (xb, jnp.zeros((), jnp.float32)),
-                    (stage_params, layer_ids))
+                    (stage_params, layer_ids), unroll=unroll)
                 return xb, aux
 
             # Largest microbatch count <= pp_microbatches such that the

@@ -169,14 +169,24 @@ def test_ulysses_tp_rejects_indivisible_heads():
             params, {"tokens": tokens})
 
 
-@pytest.mark.parametrize("schedule", ["gpipe", "interleaved"])
-@pytest.mark.parametrize("pos_encoding", ["learned", "rope"])
-def test_ulysses_pp_composition_matches_dp(pos_encoding, schedule):
-    """Pipeline (pp=2) with Ulysses sequence parallelism (sp=2): the
-    stage body calls the collective-level a2a attention inside the
-    pipeline shard_map (no nested shard_map), activations stay
-    sequence-sharded through the pp ppermute, and rope positions are
-    offset per sp shard — losses must match a plain dp run."""
+# Pairwise coverage of (impl, schedule, pos_encoding) in four runs.
+# n_layers=4 with pp_virtual_stages=2 makes the interleaved cases
+# non-degenerate (2 chunks/device — lax.switch really selects, the
+# collective-bearing stage body runs under real interleaving).
+@pytest.mark.parametrize("impl,schedule,pos_encoding", [
+    ("ulysses", "gpipe", "learned"),
+    ("ulysses", "interleaved", "rope"),
+    ("ring", "gpipe", "rope"),
+    ("ring", "interleaved", "learned"),
+])
+def test_seqparallel_pp_composition_matches_dp(impl, schedule,
+                                               pos_encoding):
+    """Pipeline (pp=2) with sequence-parallel attention (sp=2): the
+    stage body calls the collective-level attention (ulysses a2a, or
+    the ring with its reverse-ring custom VJP under the checkpointed
+    tick) inside the pipeline shard_map — no nested shard_map.
+    Activations stay sequence-sharded through the pp ppermute and rope
+    positions are offset per sp shard; losses must match plain dp."""
     from distributed_training_tpu.config import Config
     from distributed_training_tpu.data import (ShardedDataLoader,
                                                SyntheticLMDataset)
@@ -185,9 +195,9 @@ def test_ulysses_pp_composition_matches_dp(pos_encoding, schedule):
     from distributed_training_tpu.train.trainer import Trainer
 
     losses = {}
-    for tag, ndev, axes, impl in (
+    for tag, ndev, axes, attn in (
             ("dp", 2, {}, "naive"),
-            ("pp_sp", 8, {"pp": 2, "sp": 2}, "ulysses")):
+            ("pp_sp", 8, {"pp": 2, "sp": 2}, impl)):
         rt = fake_cpu_runtime(ndev, **axes)
         assert rt.data_shard_count == 2
         cfg = Config()
@@ -196,10 +206,10 @@ def test_ulysses_pp_composition_matches_dp(pos_encoding, schedule):
         cfg.train.log_every = 0
         cfg.train.learning_rate = 0.01
         model = Transformer(TransformerConfig(
-            vocab_size=64, d_model=32, n_layers=2, n_heads=4,
-            max_seq_len=16, dtype="float32", attention_impl=impl,
+            vocab_size=64, d_model=32, n_layers=4, n_heads=4,
+            max_seq_len=16, dtype="float32", attention_impl=attn,
             pos_encoding=pos_encoding, pp_microbatches=2,
-            pp_schedule=schedule, pp_virtual_stages=1))
+            pp_schedule=schedule, pp_virtual_stages=2))
         ds = SyntheticLMDataset(size=8, seq_len=16, vocab_size=64,
                                 seed=0)
         loader = ShardedDataLoader(ds, rt, batch_size=2, shuffle=False)
@@ -208,21 +218,3 @@ def test_ulysses_pp_composition_matches_dp(pos_encoding, schedule):
                        for b in loader.epoch(0)]
     np.testing.assert_allclose(losses["dp"], losses["pp_sp"],
                                rtol=1e-5, atol=1e-6)
-
-
-def test_ring_pp_still_refused():
-    """ring + pp stays an explicit refusal (reverse-ring VJP inside
-    the checkpointed pipeline tick is unwired) — and the error now
-    points at ulysses as the composable alternative."""
-    from distributed_training_tpu.models.transformer import (
-        Transformer, TransformerConfig)
-    rt = fake_cpu_runtime(8, pp=2, sp=2)
-    model = Transformer(TransformerConfig(
-        vocab_size=64, d_model=32, n_layers=2, n_heads=4,
-        max_seq_len=16, dtype="float32", attention_impl="ring"))
-    model.bind_mesh(rt.mesh)
-    params = jax.jit(model.init)(jax.random.PRNGKey(0))
-    tokens = jnp.zeros((2, 9), jnp.int32)
-    with pytest.raises(ValueError, match="ulysses"):
-        jax.jit(lambda p, b: model.loss(p, b, jax.random.PRNGKey(0)))(
-            params, {"tokens": tokens})
