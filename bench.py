@@ -196,6 +196,26 @@ def measure(batch_size: int, seq_len: int = SEQ_LEN,
     }
 
 
+def run_sweep_point(batch: int, timed_steps: int = 10,
+                    warmup_steps: int = 2, seq_len: int = SEQ_LEN,
+                    **model_kwargs) -> dict:
+    """One sweep measurement as a JSON-ready dict — shared by
+    benchmarks/sweep_mfu.py and benchmarks/tune_headline.py so every
+    sweep row is produced (and labeled) identically. Errors become an
+    ``error`` row instead of raising; the matrix continues."""
+    t0 = time.perf_counter()
+    try:
+        m = measure(batch, seq_len=seq_len, timed_steps=timed_steps,
+                    warmup_steps=warmup_steps,
+                    phase=lambda *a, **k: None, **model_kwargs)
+        m["mfu"] = round(m["mfu"], 4)
+    except Exception as e:  # noqa: BLE001 — sweeps survive OOM points
+        m = {"batch": batch, "model_kwargs": model_kwargs,
+             "error": f"{type(e).__name__}: {e}"[:300]}
+    m["point_wall_s"] = round(time.perf_counter() - t0, 1)
+    return m
+
+
 def _resolve_batch() -> int:
     """DTT_BENCH_BATCH: an int, or 'auto' = largest power-of-two batch
     whose estimated footprint fits the local chip's HBM

@@ -1,0 +1,97 @@
+#!/usr/bin/env python
+"""Summarize a jax.profiler trace: top self-time ops per device.
+
+Closes the attribution loop for MFU work without a TensorBoard UI:
+``profile_step.py --trace DIR`` writes an ``.xplane.pb``; this reads it
+back through the installed XProf plugin and prints where the step time
+actually goes (op name, self time, fraction) — so tuning decisions cite
+measured op time, not vibes.
+
+    python benchmarks/profile_step.py --batch 32 --trace /tmp/trace
+    python benchmarks/analyze_trace.py /tmp/trace --top 25
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+
+def find_xplane(trace_dir: str) -> str:
+    hits = sorted(glob.glob(os.path.join(
+        trace_dir, "**", "*.xplane.pb"), recursive=True))
+    if not hits:
+        raise FileNotFoundError(
+            f"no .xplane.pb under {trace_dir} — pass the dir given to "
+            "jax.profiler.trace / profile_step.py --trace")
+    return hits[-1]  # latest session
+
+
+def op_rows(xplane_path: str) -> list[dict]:
+    """Per-op self-time rows from the framework_op_stats tool (via the
+    standalone ``xprof`` package — the tensorboard_plugin_profile in
+    this image is protobuf-incompatible)."""
+    from xprof.convert import raw_to_tool_data
+
+    data, _ = raw_to_tool_data.xspace_to_tool_data(
+        [xplane_path], "framework_op_stats", {"tqx": "out:json;"})
+    tables = json.loads(data)
+    # First table = the op breakdown (subsequent ones are summaries).
+    table = tables[0] if isinstance(tables, list) else tables
+    cols = [c["label"] for c in table["cols"]]
+    rows = []
+    for r in table["rows"]:
+        vals = [c.get("v") for c in r["c"]]
+        rows.append(dict(zip(cols, vals)))
+    return rows
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("trace_dir")
+    ap.add_argument("--top", type=int, default=20)
+    ap.add_argument("--json", action="store_true",
+                    help="emit raw rows as JSON lines")
+    args = ap.parse_args()
+
+    path = find_xplane(args.trace_dir)
+    print(f"# {path}", file=sys.stderr)
+    rows = op_rows(path)
+
+    # Device-side ops ranked by total self time; a CPU-platform trace
+    # records everything as Host — fall back so the tool works on the
+    # 8-device CPU sim too.
+    side = "Device"
+    dev = [r for r in rows if str(r.get("Host/device", "")) == side]
+    if not dev:
+        side = "Host"
+        dev = [r for r in rows if str(r.get("Host/device", "")) == side]
+    print(f"# side={side} rows={len(dev)}", file=sys.stderr)
+    key = "Total self-time (us)"
+    if dev and key not in dev[0]:  # column name drift across versions
+        cand = [k for k in dev[0] if "self" in k.lower()
+                and "us" in k.lower()]
+        key = cand[0] if cand else key
+    dev.sort(key=lambda r: float(r.get(key) or 0), reverse=True)
+    total = sum(float(r.get(key) or 0) for r in dev)
+
+    if args.json:
+        for r in dev[:args.top]:
+            print(json.dumps(r))
+        return 0
+
+    print(f"{'self ms':>10} {'%':>6}  op")
+    for r in dev[:args.top]:
+        t = float(r.get(key) or 0)
+        name = (r.get("Operation Name") or r.get("Operation") or "?")
+        print(f"{t / 1e3:10.3f} {100 * t / max(total, 1e-9):6.2f}  "
+              f"{str(name)[:90]}")
+    print(f"{total / 1e3:10.3f} {100.0:6.2f}  TOTAL ({side} self time)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -16,7 +16,7 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
     __file__))))
 
-from bench import measure  # noqa: E402  (repo-root bench.py)
+from bench import run_sweep_point  # noqa: E402  (repo-root bench.py)
 
 
 def main() -> None:
@@ -29,18 +29,11 @@ def main() -> None:
     args = ap.parse_args()
     model_kwargs = json.loads(args.model_kwargs)
     for b in args.batches:
-        try:
-            m = measure(b, seq_len=args.seq_len,
-                        timed_steps=args.timed_steps,
-                        phase=lambda *a, **k: None, **model_kwargs)
-            m["mfu"] = round(m["mfu"], 4)
-            # measure() already records the EFFECTIVE model kwargs
-            # (headline defaults merged with ours) — don't overwrite
-            # with the raw CLI value.
-            print(json.dumps(m), flush=True)
-        except Exception as e:  # noqa: BLE001 — sweep survives OOM points
-            print(json.dumps({"batch": b, "error": str(e)[:300]}),
-                  flush=True)
+        # Success rows carry the EFFECTIVE model kwargs (headline
+        # defaults merged with ours), recorded by the shared helper.
+        print(json.dumps(run_sweep_point(
+            b, timed_steps=args.timed_steps, seq_len=args.seq_len,
+            **model_kwargs)), flush=True)
 
 
 if __name__ == "__main__":

@@ -18,12 +18,11 @@ import argparse
 import json
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
     __file__))))
 
-from bench import measure  # noqa: E402  (repo-root bench.py)
+from bench import run_sweep_point  # noqa: E402  (repo-root bench.py)
 
 # (batch, model_kwargs): ordered cheap-to-expensive so early failures
 # still leave the high-value points measured.
@@ -51,20 +50,9 @@ def main() -> None:
     args = ap.parse_args()
     points = QUICK if args.quick else MATRIX
     for batch, kwargs in points:
-        t0 = time.perf_counter()
-        try:
-            m = measure(batch, timed_steps=args.timed_steps,
-                        warmup_steps=2,
-                        phase=lambda *a, **k: None, **kwargs)
-            m["mfu"] = round(m["mfu"], 4)
-            m["point_wall_s"] = round(time.perf_counter() - t0, 1)
-            print(json.dumps(m), flush=True)
-        except Exception as e:  # noqa: BLE001 — matrix must continue
-            print(json.dumps({
-                "batch": batch, "model_kwargs": kwargs,
-                "error": f"{type(e).__name__}: {e}"[:300],
-                "point_wall_s": round(time.perf_counter() - t0, 1),
-            }), flush=True)
+        print(json.dumps(run_sweep_point(
+            batch, timed_steps=args.timed_steps, **kwargs)),
+            flush=True)
 
 
 if __name__ == "__main__":

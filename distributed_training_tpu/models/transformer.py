@@ -284,12 +284,6 @@ class Transformer:
             )
             from distributed_training_tpu.runtime import (
                 AXIS_SP, AXIS_TP)
-            if c.flash_block_q or c.flash_block_k:
-                warnings.warn(
-                    "flash_block_q/k overrides are not threaded "
-                    "through ring attention's custom-VJP kernels; the "
-                    "ring runs at the module default tiles",
-                    stacklevel=2)
             if self._inside_pp:
                 # Same pattern as the Ulysses branch: inside the
                 # pipeline's shard_map the sp axis is already manual,
@@ -297,11 +291,15 @@ class Transformer:
                 # params are replicated over tp there, so no head
                 # axis applies).
                 return ring_attention(q, k, v, axis_name=AXIS_SP,
-                                      causal=True)
+                                      causal=True,
+                                      block_q=c.flash_block_q,
+                                      block_k=c.flash_block_k)
             sizes = self._mesh_axis_sizes()
             head_ax = AXIS_TP if sizes.get(AXIS_TP, 1) > 1 else None
             fn = make_ring_attention(self.mesh, causal=True,
-                                     head_axis=head_ax)
+                                     head_axis=head_ax,
+                                     block_q=c.flash_block_q,
+                                     block_k=c.flash_block_k)
             return fn(q, k, v)
         return dot_product_attention(q, k, v, causal=True,
                                      impl=c.attention_impl,

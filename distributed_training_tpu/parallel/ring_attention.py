@@ -82,20 +82,28 @@ def _block_attn_naive(q, k, v, mode: str):
     return out, lse
 
 
-def _flash_block_ok(q, k, block_impl: str) -> bool:
+def _flash_block_ok(q, k, block_impl: str, block_q: int = 0,
+                    block_k: int = 0) -> bool:
     """Route this block through the Pallas flash kernel? Static
     decision (shapes are static under jit/shard_map). Forcing
     ``"flash"`` with non-tile-friendly shards raises: the kernel grid
     would silently leave output rows unwritten (partial tiles), and
     garbage propagated through the ring merge is far worse than a
-    trace-time error."""
+    trace-time error. Explicit tile overrides that don't divide the
+    shard raise for the same reason — a silently ignored override is
+    how sweeps misattribute their own measurements."""
     from distributed_training_tpu.ops import flash_attention as fa
+    S, Sk = q.shape[1], k.shape[1]
+    if (block_q and S % min(block_q, S)) or \
+            (block_k and Sk % min(block_k, Sk)):
+        raise ValueError(
+            f"flash tile overrides ({block_q}, {block_k}) do not "
+            f"divide the local shard lengths ({S}, {Sk})")
     if block_impl == "naive":
         return False
     if block_impl == "flash":
-        S, Sk = q.shape[1], k.shape[1]
-        bq = min(fa.DEFAULT_BLOCK_Q, S)
-        bk = min(fa.DEFAULT_BLOCK_K, Sk)
+        bq = min(block_q or fa.DEFAULT_BLOCK_Q, S)
+        bk = min(block_k or fa.DEFAULT_BLOCK_K, Sk)
         if S % bq or Sk % bk:
             raise ValueError(
                 f"block_impl='flash' forced but local shard lengths "
@@ -114,28 +122,32 @@ def _flash_block_ok(q, k, block_impl: str) -> bool:
                 "(float32/bfloat16 only)")
         return True
     # auto: same tile-friendliness rules as single-device dispatch
-    # (incl. Sq == Sk, which ring blocks always satisfy).
-    return fa.supported(q, k, k)
+    # (incl. Sq == Sk, which ring blocks always satisfy), checked
+    # against the EFFECTIVE tiles — an override must not demote the
+    # ring to the naive path against the default tiles.
+    return fa.supported(q, k, k, block_q=block_q, block_k=block_k)
 
 
 def _bhsd(x):
     return jnp.transpose(x, (0, 2, 1, 3))
 
 
-def _flash_blocks(qt):
-    """Tile sizes for a (B,H,S,D)-layout ring block."""
+def _flash_blocks(qt, block_q: int = 0, block_k: int = 0):
+    """Tile sizes for a (B,H,S,D)-layout ring block (0 → module
+    defaults, clamped to the local shard length)."""
     from distributed_training_tpu.ops import flash_attention as fa
-    return (min(fa.DEFAULT_BLOCK_Q, qt.shape[2]),
-            min(fa.DEFAULT_BLOCK_K, qt.shape[2]))
+    return (min(block_q or fa.DEFAULT_BLOCK_Q, qt.shape[2]),
+            min(block_k or fa.DEFAULT_BLOCK_K, qt.shape[2]))
 
 
-def _block_attn_flash(qt, k, v, mode: str):
+def _block_attn_flash(qt, k, v, mode: str, block_q: int = 0,
+                      block_k: int = 0):
     """One ring block via the Pallas flash kernel (MXU-tiled, O(tile)
     scores memory). ``qt`` is the loop-invariant (B,H,S,D) transpose of
     the local queries — hoisted out of the ring scan by the caller
     (k/v rotate, so their transposes legitimately live in the step)."""
     from distributed_training_tpu.ops import flash_attention as fa
-    bq, bk = _flash_blocks(qt)
+    bq, bk = _flash_blocks(qt, block_q, block_k)
     # f32 out: per-block partials must not round to the input dtype
     # before the cross-block merge (the naive path is f32 throughout;
     # single-device flash rounds exactly once, at the very end).
@@ -165,7 +177,8 @@ def _ring_perm(sp: int):
 
 
 def _ring_fwd_scan(q, k, v, axis_name: str, causal: bool,
-                   block_impl: str):
+                   block_impl: str, block_q: int = 0,
+                   block_k: int = 0):
     """Full ring cycle of online-softmax accumulation. Returns the
     normalized output (B, S, H, D) in q.dtype and per-row logsumexp
     (B, H, S) fp32."""
@@ -174,14 +187,15 @@ def _ring_fwd_scan(q, k, v, axis_name: str, causal: bool,
     B, S, H, D = q.shape
     perm = _ring_perm(sp)
 
-    use_flash = _flash_block_ok(q, k, block_impl)
+    use_flash = _flash_block_ok(q, k, block_impl, block_q, block_k)
     # Loop-invariant: hoisted here because XLA's while-loop LICM does
     # not lift computations out of lax.switch branch computations.
     qt = _bhsd(q) if use_flash else None
 
     def block(kv, mode):
         if use_flash:
-            return _block_attn_flash(qt, kv[0], kv[1], mode)
+            return _block_attn_flash(qt, kv[0], kv[1], mode,
+                                     block_q, block_k)
         return _block_attn_naive(q, kv[0], kv[1], mode)
 
     out0 = jnp.zeros((B, S, H, D), jnp.float32)
@@ -260,14 +274,15 @@ def _block_grads_naive(q, k, v, do_g, lse, delta, mode: str):
     return dq.reshape(B, Sq, H, D), dk, dv
 
 
-def _block_grads_flash(qt, dot, k, v, lse, delta, mode: str):
+def _block_grads_flash(qt, dot, k, v, lse, delta, mode: str,
+                       block_q: int = 0, block_k: int = 0):
     """Per-block gradients via the Pallas flash backward kernels. Feeds
     the FINAL (lse, delta) — the FA2 trick makes per-block kernels
     compose into the ring total without any per-block statistics.
     ``qt``/``dot`` are the loop-invariant (B,H,S,D) transposes of the
     local queries / upstream grads, hoisted out of the ring scan."""
     from distributed_training_tpu.ops import flash_attention as fa
-    bq, bk = _flash_blocks(qt)
+    bq, bk = _flash_blocks(qt, block_q, block_k)
     dq, dk, dv = fa._flash_bwd(
         qt, _bhsd(k), _bhsd(v), None, lse[..., None], dot,
         causal=(mode == "causal"), block_q=bq, block_k=bk,
@@ -275,18 +290,23 @@ def _block_grads_flash(qt, dot, k, v, lse, delta, mode: str):
     return _bhsd(dq), _bhsd(dk), _bhsd(dv)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def _ring_core(q, k, v, axis_name, causal, block_impl):
-    out, _ = _ring_fwd_scan(q, k, v, axis_name, causal, block_impl)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _ring_core(q, k, v, axis_name, causal, block_impl,
+               block_q=0, block_k=0):
+    out, _ = _ring_fwd_scan(q, k, v, axis_name, causal, block_impl,
+                            block_q, block_k)
     return out
 
 
-def _ring_core_fwd(q, k, v, axis_name, causal, block_impl):
-    out, lse = _ring_fwd_scan(q, k, v, axis_name, causal, block_impl)
+def _ring_core_fwd(q, k, v, axis_name, causal, block_impl,
+                   block_q=0, block_k=0):
+    out, lse = _ring_fwd_scan(q, k, v, axis_name, causal, block_impl,
+                              block_q, block_k)
     return out, (q, k, v, out, lse)
 
 
-def _ring_core_bwd(axis_name, causal, block_impl, res, do):
+def _ring_core_bwd(axis_name, causal, block_impl, block_q, block_k,
+                   res, do):
     """Reverse ring: KV blocks make a second full rotation; each step
     recomputes that block's softmax and adds its dk/dv contribution into
     accumulators that TRAVEL WITH the block — after sp rotations the
@@ -308,7 +328,7 @@ def _ring_core_bwd(axis_name, causal, block_impl, res, do):
     # Loop-invariant per-path precomputes, hoisted out of the scan
     # (XLA's while-loop LICM does not lift out of switch branches):
     # flash wants (B,H,S,D) q/dO; the einsum path wants grouped dO.
-    use_flash = _flash_block_ok(q, k, block_impl)
+    use_flash = _flash_block_ok(q, k, block_impl, block_q, block_k)
     if use_flash:
         qt, dot, do_g = _bhsd(q), _bhsd(do), None
     else:
@@ -319,7 +339,7 @@ def _ring_core_bwd(axis_name, causal, block_impl, res, do):
     def block_grads(kv, mode):
         if use_flash:
             return _block_grads_flash(qt, dot, kv[0], kv[1], lse,
-                                      delta, mode)
+                                      delta, mode, block_q, block_k)
         return _block_grads_naive(q, kv[0], kv[1], do_g, lse, delta,
                                   mode)
 
@@ -372,7 +392,8 @@ _ring_core.defvjp(_ring_core_fwd, _ring_core_bwd)
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                    axis_name: str = AXIS_SP,
                    causal: bool = True,
-                   block_impl: str = "auto") -> jax.Array:
+                   block_impl: str = "auto",
+                   block_q: int = 0, block_k: int = 0) -> jax.Array:
     """Sequence-parallel attention; call INSIDE shard_map.
 
     Shapes are per-device shards: q/k/v (B, S_local, H|Hkv, D) where the
@@ -380,7 +401,9 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     order. Output matches q's shape/dtype. ``block_impl``: per-block
     attention kernel — "auto" uses the Pallas flash kernel when the
     local shard is tile-friendly (fwd AND reverse-ring bwd), else the
-    einsum reference; "naive"/"flash" force a path.
+    einsum reference; "naive"/"flash" force a path. ``block_q``/
+    ``block_k`` override the flash tiles (0 → module defaults; must
+    divide the local shard — raises rather than silently ignore).
     """
     sp = jax.lax.axis_size(axis_name)
 
@@ -392,13 +415,15 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                                    "causal" if causal else "full")
         return out.astype(q.dtype)
 
-    return _ring_core(q, k, v, axis_name, causal, block_impl)
+    return _ring_core(q, k, v, axis_name, causal, block_impl,
+                      block_q, block_k)
 
 
 def make_ring_attention(mesh: Mesh, causal: bool = True,
                         batch_axes=BATCH_AXES,
                         head_axis: str | None = None,
-                        block_impl: str = "auto"):
+                        block_impl: str = "auto",
+                        block_q: int = 0, block_k: int = 0):
     """Build the shard_map'd ring-attention fn over global (B, S, H, D)
     arrays: batch over ``batch_axes``, sequence over ``sp``, heads over
     ``head_axis`` (pass ``tp`` to compose SP with tensor parallelism).
@@ -406,7 +431,8 @@ def make_ring_attention(mesh: Mesh, causal: bool = True,
     spec = P(tuple(batch_axes) or None, AXIS_SP, head_axis, None)
     return shard_map(
         functools.partial(ring_attention, axis_name=AXIS_SP,
-                          causal=causal, block_impl=block_impl),
+                          causal=causal, block_impl=block_impl,
+                          block_q=block_q, block_k=block_k),
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,

@@ -57,11 +57,8 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     ``local_impl`` feeds ops.dot_product_attention for the full-sequence
     local attention ("auto" → Pallas flash on TPU); ``block_q``/
     ``block_k`` are the flash tile overrides (0 → kernel defaults),
-    threaded so the bench sweep tunes the single-device and Ulysses
-    layouts with one knob. (The ring layout is the exception: its
-    per-block kernels always run at the module defaults — the
-    overrides don't reach through its custom-VJP machinery, and the
-    model warns if you set them together.)
+    threaded so the bench sweep tunes every attention layout
+    (single-device, Ulysses, and the ring) with one knob.
     """
     sp = jax.lax.axis_size(axis_name)
     if sp == 1:

@@ -222,3 +222,25 @@ def test_ring_forced_flash_rejects_partial_tiles():
                              block_impl="flash")
     with pytest.raises(ValueError, match="divisible"):
         jax.jit(fn)(q, k, v)
+
+
+def test_ring_tile_overrides_validated(cpu8):
+    """flash_block_q/k thread into the ring (one sweep knob for every
+    attention layout); overrides that don't divide the local shard
+    raise instead of being silently ignored."""
+    import jax
+    from distributed_training_tpu.parallel.ring_attention import (
+        make_ring_attention,
+    )
+    from distributed_training_tpu.runtime import fake_cpu_runtime
+    rt = fake_cpu_runtime(8, sp=4)
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q, k, v = (jax.random.normal(kk, (2, 64, 4, 16)) for kk in ks)
+    # S_local = 16; 12 does not divide it -> loud failure.
+    bad = make_ring_attention(rt.mesh, block_q=12)
+    with pytest.raises(ValueError, match="tile overrides"):
+        jax.jit(bad)(q, k, v)
+    # 16 divides -> fine (naive fallback on CPU, same validation path).
+    ok = make_ring_attention(rt.mesh, block_q=16, block_k=16)
+    out = jax.jit(ok)(q, k, v)
+    assert out.shape == q.shape
