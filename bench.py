@@ -197,8 +197,8 @@ def measure(batch_size: int, seq_len: int = SEQ_LEN,
 
 
 def run_sweep_point(batch: int, timed_steps: int = 10,
-                    warmup_steps: int = 2, seq_len: int = SEQ_LEN,
-                    **model_kwargs) -> dict:
+                    warmup_steps: int = WARMUP_STEPS,
+                    seq_len: int = SEQ_LEN, **model_kwargs) -> dict:
     """One sweep measurement as a JSON-ready dict — shared by
     benchmarks/sweep_mfu.py and benchmarks/tune_headline.py so every
     sweep row is produced (and labeled) identically. Errors become an

@@ -44,7 +44,8 @@ def op_rows(xplane_path: str) -> list[dict]:
     cols = [c["label"] for c in table["cols"]]
     rows = []
     for r in table["rows"]:
-        vals = [c.get("v") for c in r["c"]]
+        # gviz represents empty cells as nulls in the 'c' array.
+        vals = [(c or {}).get("v") for c in r["c"]]
         rows.append(dict(zip(cols, vals)))
     return rows
 

@@ -75,11 +75,14 @@ CONFIGS: dict = {
         "sample_unit": "images",
     },
     "gpt2_125m_ddp": {
-        "desc": "GPT-2 125M, synthetic LM corpus, DP",
-        "model": ("gpt2_125m", {"attention_impl": "auto"}),
+        "desc": "GPT-2 125M, synthetic LM corpus, DP (same tuned "
+                "config as the headline bench.py: batch 32 + "
+                "remat_policy='mlp' — see docs/performance.md)",
+        "model": ("gpt2_125m", {"attention_impl": "auto",
+                                "remat": True, "remat_policy": "mlp"}),
         "seq_len": 1024,
         "overrides": _base({
-            "train.batch_size": 8,
+            "train.batch_size": 32,
             "train.dataset": "synthetic_lm",
             "train.dataset_kwargs": {"size": 128, "seq_len": 1024,
                                      "vocab_size": 50257},

@@ -65,6 +65,10 @@ class TrainConfig:
     seed: int = 42
     optimizer: str = "sgd"        # "sgd" | "adamw" | "adafactor"
     weight_decay: float = 0.0
+    # AdamW decay scope: "all" = every param (torch.optim.AdamW's
+    # default, the parity baseline); "matrices" = only >=2-D params
+    # (the transformer convention — biases/LayerNorm excluded).
+    decay_mask: str = "all"
     b1: float = 0.9
     b2: float = 0.95
     grad_clip_norm: float = 0.0   # 0 disables

@@ -410,7 +410,14 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     if sp == 1:
         # Degenerate ring: plain block attention under autodiff (the
         # naive block — the Pallas fwd kernel alone has no vjp outside
-        # the ring's custom VJP).
+        # the ring's custom VJP). The raise-don't-ignore contract on
+        # tile overrides still applies.
+        S, Sk = q.shape[1], k.shape[1]
+        if (block_q and S % min(block_q, S)) or \
+                (block_k and Sk % min(block_k, Sk)):
+            raise ValueError(
+                f"flash tile overrides ({block_q}, {block_k}) do not "
+                f"divide the local shard lengths ({S}, {Sk})")
         out, _ = _block_attn_naive(q, k, v,
                                    "causal" if causal else "full")
         return out.astype(q.dtype)

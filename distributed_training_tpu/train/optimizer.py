@@ -32,21 +32,38 @@ def build_schedule(cfg: TrainConfig, total_steps: int):
     return sched
 
 
+def _matrices_mask(params):
+    """Decay only >=2-D params: biases and LayerNorm scales/offsets are
+    excluded (the standard transformer convention; embeddings, being
+    matrices, do decay under this heuristic)."""
+    import jax
+    return jax.tree.map(lambda p: getattr(p, "ndim", 0) >= 2, params)
+
+
 def build_optimizer(cfg: TrainConfig,
                     total_steps: int) -> optax.GradientTransformation:
+    if cfg.decay_mask not in ("all", "matrices"):
+        raise ValueError(f"unknown decay_mask '{cfg.decay_mask}' "
+                         "(expected 'all' or 'matrices')")
     sched = build_schedule(cfg, total_steps)
     if cfg.optimizer == "sgd":
         core = optax.sgd(sched)
     elif cfg.optimizer == "adamw":
+        # decay_mask="all" reproduces torch.optim.AdamW's default
+        # (decays every param — pinned by tests/test_torch_parity.py);
+        # "matrices" is the transformer-training convention.
+        mask = _matrices_mask if cfg.decay_mask == "matrices" else None
         core = optax.adamw(sched, b1=cfg.b1, b2=cfg.b2,
-                           weight_decay=cfg.weight_decay)
+                           weight_decay=cfg.weight_decay, mask=mask)
     elif cfg.optimizer == "adafactor":
         # TPU-idiomatic memory-lean choice for the largest FSDP
         # configs: factored second moment ≈ (rows+cols) state per
         # matrix instead of Adam's 2x full-size fp32 moments.
+        mask = _matrices_mask if cfg.decay_mask == "matrices" else None
         core = optax.adafactor(sched,
                                weight_decay_rate=(cfg.weight_decay
-                                                  or None))
+                                                  or None),
+                               weight_decay_mask=mask)
     else:
         raise ValueError(f"unknown optimizer '{cfg.optimizer}'")
     parts = []
