@@ -61,7 +61,10 @@ class TrainConfig:
     dataset_size: int = 2048
     learning_rate: float = 1e-3
     device: str = "auto"          # "auto" | "tpu" | "cpu"
-    parallel_strategy: str = "ddp"  # "ddp" | "fsdp" (+ framework extensions)
+    # "ddp" | "fsdp" (reference parity) + framework extensions:
+    # "zero1" (DDP compute, moments sharded over data axes),
+    # "hybrid" (FSDP in-slice, replicate across dp), "tp".
+    parallel_strategy: str = "ddp"
     seed: int = 42
     optimizer: str = "sgd"        # "sgd" | "adamw" | "adafactor"
     weight_decay: float = 0.0
@@ -266,6 +269,36 @@ def _is_open_path(dotted: str) -> bool:
 # ---------------------------------------------------------------------------
 
 
+def _coerce_scalar(ftype: type, v: Any, path: str) -> Any:
+    """Coerce YAML scalars into the schema's type. Load-bearing for
+    floats: PyYAML's float regex requires a dot, so Hydra-style
+    ``train.learning_rate=3e-3`` arrives as the STRING '3e-3' and
+    would flow into the optimizer uncoerced."""
+    if isinstance(v, bool) or not isinstance(v, (str, int, float)):
+        return v
+    try:
+        if ftype is float and not isinstance(v, float):
+            return float(v)
+        if ftype is int and isinstance(v, str):
+            return int(v)
+        if ftype is int and isinstance(v, float):
+            if v != int(v):
+                raise ValueError(v)  # 2.5 into an int field is junk
+            return int(v)
+        if ftype is bool and isinstance(v, str):
+            lv = v.lower()
+            if lv in ("true", "1", "yes"):
+                return True
+            if lv in ("false", "0", "no"):
+                return False
+            raise ValueError(v)
+    except ValueError as e:
+        raise ConfigError(
+            f"cannot parse {v!r} as {ftype.__name__} for '{path}'"
+        ) from e
+    return v
+
+
 def _build_dataclass(cls: type, data: dict[str, Any], path: str) -> Any:
     import typing
     hints = typing.get_type_hints(cls)  # resolve string annotations
@@ -277,6 +310,8 @@ def _build_dataclass(cls: type, data: dict[str, Any], path: str) -> Any:
             ftype = hints.get(k, fields[k].type)
             if dataclasses.is_dataclass(ftype) and isinstance(v, dict):
                 v = _build_dataclass(ftype, v, f"{path}.{k}")
+            elif isinstance(ftype, type):
+                v = _coerce_scalar(ftype, v, f"{path}.{k}")
             kwargs[k] = v
         else:
             extra[k] = v

@@ -16,6 +16,7 @@ from distributed_training_tpu.parallel.strategy import (  # noqa: F401
     FullyShardedDataParallel,
     ShardingStrategy,
     TensorParallel,
+    ZeRO1,
     get_strategy,
     logical_to_spec,
 )

@@ -31,6 +31,11 @@ def state_specs(strategy: ShardingStrategy,
     (the trainer shares one abstract trace with state_shardings).
     """
     param_specs = strategy.specs_for_tree(param_shapes, logical_axes)
+    # Param-shaped optimizer leaves get the strategy's OPT layout —
+    # identical to the param layout except under ZeRO-1, where moments
+    # shard over the data axes while params stay replicated.
+    opt_base_specs = strategy.opt_specs_for_tree(param_shapes,
+                                                 logical_axes)
     if opt_shapes is None:
         opt_shapes = jax.eval_shape(optimizer.init, param_shapes)
 
@@ -50,7 +55,7 @@ def state_specs(strategy: ShardingStrategy,
         optimizer,
         spec_for_opt_leaf,
         opt_shapes,
-        param_specs,
+        opt_base_specs,
         transform_non_params=lambda _leaf: P(),
         is_leaf=lambda x: isinstance(x, jax.ShapeDtypeStruct),
     )

@@ -97,3 +97,29 @@ def test_override_scalar_intermediate_rejected():
         load_config(CONF, overrides=["train.batch_size.typo=1"])
     with pytest.raises(ConfigError):
         load_config(CONF, overrides=["+train.batch_size.typo=1"])
+
+
+def test_scientific_notation_override_coerces():
+    """PyYAML parses dot-less exponents ('3e-3') as STRINGS; the schema
+    boundary must coerce them into float fields (this silently broke
+    any CLI run setting train.learning_rate=3e-3)."""
+    from distributed_training_tpu.config import (ConfigError,
+                                                 config_from_dict)
+    cfg = config_from_dict({"train": {"learning_rate": "3e-3",
+                                      "batch_size": "16",
+                                      "nan_guard": "true"}})
+    assert cfg.train.learning_rate == pytest.approx(3e-3)
+    assert cfg.train.batch_size == 16
+    assert cfg.train.nan_guard is True
+    with pytest.raises(ConfigError, match="learning_rate"):
+        config_from_dict({"train": {"learning_rate": "fast"}})
+
+
+def test_int_field_rejects_fractional_float():
+    from distributed_training_tpu.config import (ConfigError,
+                                                 config_from_dict)
+    cfg = config_from_dict({"train": {"batch_size": 32.0}})
+    assert cfg.train.batch_size == 32 and \
+        isinstance(cfg.train.batch_size, int)
+    with pytest.raises(ConfigError, match="batch_size"):
+        config_from_dict({"train": {"batch_size": 2.5}})
