@@ -108,3 +108,41 @@ def test_offload_checkpoint_roundtrip(cpu8, tmp_path):
              and leaf.size > 1}
     assert kinds == {"pinned_host"}
     c2.close()
+
+
+def test_offload_composes_with_zero1(cpu8):
+    """Host-offloaded moments that are ALSO sharded over the data axes
+    (zero1): per-step device_put round-trips preserve both the
+    sharding and the trajectory (bit-parity vs plain ddp)."""
+    if not state_lib.supports_memory_kind(cpu8.mesh, "pinned_host"):
+        pytest.skip("no pinned_host memory on this backend")
+    from distributed_training_tpu.data import SyntheticLMDataset
+    from distributed_training_tpu.models.transformer import (
+        Transformer, TransformerConfig)
+    from distributed_training_tpu.runtime import fake_cpu_runtime
+
+    def run(strat, offload):
+        rt = fake_cpu_runtime(8)  # dp=8
+        cfg = Config()
+        cfg.train.batch_size = 1
+        cfg.train.total_epochs = 1
+        cfg.train.log_every = 0
+        cfg.train.optimizer = "adamw"
+        cfg.train.learning_rate = 0.01
+        cfg.train.parallel_strategy = strat
+        cfg.train.min_shard_elems = 1
+        cfg.train.offload_opt_state = offload
+        model = Transformer(TransformerConfig(
+            vocab_size=64, d_model=32, n_layers=2, n_heads=4,
+            max_seq_len=16, dtype="float32", attention_impl="naive"))
+        ds = SyntheticLMDataset(size=16, seq_len=16, vocab_size=64,
+                                seed=0)
+        loader = ShardedDataLoader(ds, rt, batch_size=1,
+                                   shuffle=False)
+        trainer = Trainer(cfg, rt, model, loader)
+        return [float(trainer.train_step(b)["loss"])
+                for b in loader.epoch(0)]
+
+    np.testing.assert_allclose(run("ddp", False),
+                               run("zero1", True),
+                               rtol=1e-5, atol=1e-6)

@@ -341,3 +341,36 @@ def test_interleaved_transformer_matches_gpipe():
         losses[sched] = float(loss)
     assert losses["gpipe"] == pytest.approx(losses["interleaved"],
                                             rel=1e-6)
+
+
+def test_pp_composes_with_grad_accum():
+    """Two microbatching levels at once — the trainer's grad-accum scan
+    over the pipeline's own pp-microbatch wavefront — must reproduce
+    the plain-dp trajectory at the same global batch. Shapes chosen so
+    the pp autodivisor really picks M=2 (per-accum-chunk B=4 over
+    dp=2 shards): a dp=4 variant would silently degrade to M=1 and
+    test nothing."""
+    def run(ndev, axes, accum, bs):
+        rt = fake_cpu_runtime(ndev, **axes)
+        cfg = Config()
+        cfg.train.batch_size = bs
+        cfg.train.total_epochs = 1
+        cfg.train.log_every = 0
+        cfg.train.optimizer = "adamw"
+        cfg.train.learning_rate = 0.01
+        cfg.train.grad_accum_steps = accum
+        model = Transformer(TransformerConfig(
+            vocab_size=64, d_model=32, n_layers=2, n_heads=4,
+            max_seq_len=16, dtype="float32", attention_impl="naive",
+            pp_microbatches=2))
+        ds = SyntheticLMDataset(size=16, seq_len=16, vocab_size=64,
+                                seed=0)
+        loader = ShardedDataLoader(ds, rt, batch_size=bs,
+                                   shuffle=False)
+        trainer = Trainer(cfg, rt, model, loader)
+        return [float(trainer.train_step(b)["loss"])
+                for b in loader.epoch(0)]
+
+    base = run(2, {}, 1, 4)                       # global batch 8
+    pp_accum = run(4, {"pp": 2, "dp": 2}, 2, 4)   # global batch 8
+    np.testing.assert_allclose(base, pp_accum, rtol=1e-5, atol=1e-6)
