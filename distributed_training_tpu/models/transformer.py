@@ -67,6 +67,13 @@ class TransformerConfig:
     # large batches.
     remat_policy: str = "selective"  # "full" | "selective" | "mlp"
     attention_impl: str = "auto"
+    # Sliding-window (Mistral-style) attention: query i attends keys
+    # in [i − window + 1, i]. 0 = full causal. Flash kernels skip
+    # out-of-band blocks (O(S·window) FLOPs); composes with the
+    # single-device and Ulysses impls (the local attention there sees
+    # the full sequence); the ring's per-block geometry is different —
+    # refused rather than silently full-causal.
+    attention_window: int = 0
     # Flash-kernel tile overrides (0 → ops/flash_attention defaults);
     # exposed so the bench sweep can tune them on real hardware.
     flash_block_q: int = 0
@@ -127,6 +134,10 @@ class TransformerConfig:
             raise ValueError(
                 f"unknown loss_impl '{self.loss_impl}' "
                 "(expected 'fused' or 'dense')")
+        if self.attention_window < 0:
+            raise ValueError(
+                f"attention_window must be >= 0, got "
+                f"{self.attention_window}")
         if self.scan_unroll < 1 or self.n_layers % self.scan_unroll:
             raise ValueError(
                 f"scan_unroll ({self.scan_unroll}) must be >= 1 and "
@@ -261,7 +272,8 @@ class Transformer:
                     return ulysses_attention(
                         q, k, v, axis_name=AXIS_SP, causal=True,
                         block_q=c.flash_block_q,
-                        block_k=c.flash_block_k)
+                        block_k=c.flash_block_k,
+                        window=c.attention_window)
                 if c.n_kv_heads % (tp * sp) or c.n_heads % (tp * sp):
                     # Heads are the shard currency for BOTH tp and the
                     # Ulysses a2a — refuse up front with global counts
@@ -277,11 +289,19 @@ class Transformer:
                 fn = make_ulysses_attention(self.mesh, causal=True,
                                             block_q=c.flash_block_q,
                                             block_k=c.flash_block_k,
-                                            head_axis=head_ax)
+                                            head_axis=head_ax,
+                                            window=c.attention_window)
                 return fn(q, k, v)
             from distributed_training_tpu.parallel.ring_attention import (
                 make_ring_attention, ring_attention,
             )
+            # (only the ring reaches here — ulysses returned above)
+            if c.attention_window:
+                raise ValueError(
+                    "attention_window is not wired through the ring's "
+                    "per-block geometry; use attention_impl='ulysses' "
+                    "(full-sequence local attention) for windowed "
+                    "long-context")
             from distributed_training_tpu.runtime import (
                 AXIS_SP, AXIS_TP)
             if self._inside_pp:
@@ -304,7 +324,8 @@ class Transformer:
         return dot_product_attention(q, k, v, causal=True,
                                      impl=c.attention_impl,
                                      block_q=c.flash_block_q,
-                                     block_k=c.flash_block_k)
+                                     block_k=c.flash_block_k,
+                                     window=c.attention_window)
 
     # -- init --------------------------------------------------------------
 

@@ -24,7 +24,8 @@ from einops import rearrange
 
 def _naive_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                      causal: bool = True,
-                     segment_mask: jax.Array | None = None) -> jax.Array:
+                     segment_mask: jax.Array | None = None,
+                     window: int = 0) -> jax.Array:
     """Reference attention. Shapes: q (B, Sq, H, D); k/v (B, Sk, Hkv, D).
 
     Supports grouped-query attention (Hkv divides H). Softmax in fp32
@@ -39,12 +40,20 @@ def _naive_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     scale = D ** -0.5
     logits = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k,
                         preferred_element_type=jnp.float32) * scale
+    if window < 0:
+        raise ValueError(f"window must be >= 0, got {window}")
+    if window and not causal:
+        raise ValueError("window > 0 requires causal=True")
     if causal:
         Sk = k.shape[1]
         # Offset alignment: query i attends keys <= i + (Sk - Sq)
         # (supports the ring-attention case where Sq < Sk).
-        mask = (jnp.arange(Sk)[None, :]
-                <= (jnp.arange(Sq)[:, None] + (Sk - Sq)))
+        rows = jnp.arange(Sq)[:, None] + (Sk - Sq)
+        cols = jnp.arange(Sk)[None, :]
+        mask = cols <= rows
+        if window:
+            # Sliding window: keys in [i - window + 1, i] only.
+            mask = jnp.logical_and(mask, cols >= rows - (window - 1))
         logits = jnp.where(mask[None, None, None], logits, -jnp.inf)
     if segment_mask is not None:
         logits = jnp.where(segment_mask, logits, -jnp.inf)
@@ -64,7 +73,8 @@ def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                           causal: bool = True,
                           impl: str = "auto",
                           block_q: int | None = None,
-                          block_k: int | None = None) -> jax.Array:
+                          block_k: int | None = None,
+                          window: int = 0) -> jax.Array:
     """Dispatching attention entrypoint. ``impl``:
 
     - "auto": flash on TPU when shapes are tile-friendly, else naive
@@ -82,8 +92,9 @@ def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                 kw["block_q"] = block_q
             if block_k:
                 kw["block_k"] = block_k
-            return fa.flash_attention(q, k, v, causal=causal, **kw)
+            return fa.flash_attention(q, k, v, causal=causal,
+                                      window=window, **kw)
         impl = "naive"
     if impl == "naive":
-        return _naive_attention(q, k, v, causal)
+        return _naive_attention(q, k, v, causal, window=window)
     raise ValueError(f"unknown attention impl '{impl}'")

@@ -40,17 +40,31 @@ DEFAULT_BLOCK_K = 256
 NEG_INF = -1e30
 
 
-def _block_needed(causal: bool, q_start, k_start, block_q: int):
-    """False only for k-blocks entirely above the causal diagonal."""
-    return jnp.logical_or(not causal, k_start <= q_start + block_q - 1)
+def _block_needed(causal: bool, q_start, k_start, block_q: int,
+                  block_k: int = 0, window: int = 0):
+    """False for k-blocks with no live (query, key) pair: entirely
+    above the causal diagonal, or — with a sliding ``window`` (query i
+    attends keys in [i − window + 1, i]) — entirely below every
+    query's window start. Skipped blocks cost zero FLOPs, so windowed
+    attention is O(S·window), not O(S²)."""
+    needed = jnp.logical_or(not causal, k_start <= q_start + block_q - 1)
+    if window > 0:
+        needed = jnp.logical_and(
+            needed,
+            k_start + block_k - 1 >= q_start - window + 1)
+    return needed
 
 
-def _apply_causal_mask(s, q_start, k_start, block_q: int, block_k: int):
+def _apply_causal_mask(s, q_start, k_start, block_q: int, block_k: int,
+                       window: int = 0):
     rows = q_start + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 0)
     cols = k_start + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 1)
-    return jnp.where(cols <= rows, s, NEG_INF)
+    live = cols <= rows
+    if window > 0:
+        live = jnp.logical_and(live, cols >= rows - (window - 1))
+    return jnp.where(live, s, NEG_INF)
 
 
 def _platform_is_tpu() -> bool:
@@ -98,7 +112,7 @@ def supported(q: jax.Array, k: jax.Array, v: jax.Array,
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
                 acc_ref, m_ref, l_ref, *, scale, block_q, block_k,
-                causal):
+                causal, window=0):
     qi = pl.program_id(2)
     ki = pl.program_id(3)
     nk = pl.num_programs(3)
@@ -111,8 +125,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
 
     q_start = qi * block_q
     k_start = ki * block_k
-    # Causal: skip blocks entirely above the diagonal.
-    needed = _block_needed(causal, q_start, k_start, block_q)
+    # Causal: skip blocks entirely above the diagonal (and, with a
+    # sliding window, entirely below it).
+    needed = _block_needed(causal, q_start, k_start, block_q,
+                           block_k, window)
 
     @pl.when(needed)
     def _compute():
@@ -123,7 +139,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale  # (bq, bk)
         if causal:
-            s = _apply_causal_mask(s, q_start, k_start, block_q, block_k)
+            s = _apply_causal_mask(s, q_start, k_start, block_q,
+                                   block_k, window)
 
         m_prev = m_ref[:]                          # (bq, 1)
         m_cur = jnp.max(s, axis=1, keepdims=True)
@@ -144,7 +161,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         lse_ref[0, 0] = m_ref[:] + jnp.log(l_safe)  # (bq, 1)
 
 
-def _flash_fwd(q, k, v, *, causal, block_q, block_k, out_dtype=None):
+def _flash_fwd(q, k, v, *, causal, block_q, block_k, out_dtype=None,
+               window=0):
     """q: (B, H, S, D); k/v: (B, Hkv, Sk, D) with Hkv dividing H — GQA is
     expressed in the KV BlockSpec index maps (h → h // reps), so grouped
     KV heads are never materialized at H resolution in HBM.
@@ -160,7 +178,7 @@ def _flash_fwd(q, k, v, *, causal, block_q, block_k, out_dtype=None):
 
     kernel = functools.partial(
         _fwd_kernel, scale=scale, block_q=block_q, block_k=block_k,
-        causal=causal)
+        causal=causal, window=window)
 
     out, lse = pl.pallas_call(
         kernel,
@@ -201,7 +219,8 @@ def _flash_fwd(q, k, v, *, causal, block_q, block_k, out_dtype=None):
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                   dq_ref, dq_acc, *, scale, block_q, block_k, causal):
+                   dq_ref, dq_acc, *, scale, block_q, block_k, causal,
+                   window=0):
     qi = pl.program_id(2)
     ki = pl.program_id(3)
     nk = pl.num_programs(3)
@@ -212,7 +231,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     q_start = qi * block_q
     k_start = ki * block_k
-    needed = _block_needed(causal, q_start, k_start, block_q)
+    needed = _block_needed(causal, q_start, k_start, block_q,
+                           block_k, window)
 
     @pl.when(needed)
     def _compute():
@@ -226,7 +246,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
         if causal:
-            s = _apply_causal_mask(s, q_start, k_start, block_q, block_k)
+            s = _apply_causal_mask(s, q_start, k_start, block_q,
+                                   block_k, window)
         p = jnp.exp(s - lse)                       # (bq, bk)
         dp = jax.lax.dot_general(
             do, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
@@ -243,7 +264,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, dk_acc, dv_acc, *, scale, block_q,
-                    block_k, causal):
+                    block_k, causal, window=0):
     ki = pl.program_id(2)
     qi = pl.program_id(3)
     nq = pl.num_programs(3)
@@ -255,7 +276,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     q_start = qi * block_q
     k_start = ki * block_k
-    needed = _block_needed(causal, q_start, k_start, block_q)
+    needed = _block_needed(causal, q_start, k_start, block_q,
+                           block_k, window)
 
     @pl.when(needed)
     def _compute():
@@ -269,7 +291,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
         if causal:
-            s = _apply_causal_mask(s, q_start, k_start, block_q, block_k)
+            s = _apply_causal_mask(s, q_start, k_start, block_q,
+                                   block_k, window)
         p = jnp.exp(s - lse)                       # (bq, bk)
         dv_acc[:] += jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
@@ -289,6 +312,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _flash_bwd(q, k, v, out, lse, do, *, causal, block_q, block_k,
+               window=0,
                delta=None, grads_dtype=None):
     """``out`` is consumed only to derive ``delta``; callers that
     precompute delta (it is loop-invariant in the ring) pass
@@ -310,7 +334,8 @@ def _flash_bwd(q, k, v, out, lse, do, *, causal, block_q, block_k,
     interp = not _platform_is_tpu()
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, block_q=block_q,
-                          block_k=block_k, causal=causal),
+                          block_k=block_k, causal=causal,
+                          window=window),
         grid=(B, H, nq, nk),
         in_specs=[
             pl.BlockSpec((1, 1, block_q, D),
@@ -337,7 +362,8 @@ def _flash_bwd(q, k, v, out, lse, do, *, causal, block_q, block_k,
     # Hkv afterwards; KV reads stay at Hkv resolution via the index map.
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, block_q=block_q,
-                          block_k=block_k, causal=causal),
+                          block_k=block_k, causal=causal,
+                          window=window),
         grid=(B, H, nk, nq),
         in_specs=[
             pl.BlockSpec((1, 1, block_q, D),
@@ -380,23 +406,24 @@ def _flash_bwd(q, k, v, out, lse, do, *, causal, block_q, block_k,
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def _flash_bhsd(q, k, v, causal, block_q, block_k):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_bhsd(q, k, v, causal, block_q, block_k, window=0):
     out, _ = _flash_fwd(q, k, v, causal=causal, block_q=block_q,
-                        block_k=block_k)
+                        block_k=block_k, window=window)
     return out
 
 
-def _flash_bhsd_fwd(q, k, v, causal, block_q, block_k):
+def _flash_bhsd_fwd(q, k, v, causal, block_q, block_k, window=0):
     out, lse = _flash_fwd(q, k, v, causal=causal, block_q=block_q,
-                          block_k=block_k)
+                          block_k=block_k, window=window)
     return out, (q, k, v, out, lse)
 
 
-def _flash_bhsd_bwd(causal, block_q, block_k, res, do):
+def _flash_bhsd_bwd(causal, block_q, block_k, window, res, do):
     q, k, v, out, lse = res
     dq, dk, dv = _flash_bwd(q, k, v, out, lse, do, causal=causal,
-                            block_q=block_q, block_k=block_k)
+                            block_q=block_q, block_k=block_k,
+                            window=window)
     return dq, dk, dv
 
 
@@ -406,8 +433,17 @@ _flash_bhsd.defvjp(_flash_bhsd_fwd, _flash_bhsd_bwd)
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     causal: bool = True,
                     block_q: int = DEFAULT_BLOCK_Q,
-                    block_k: int = DEFAULT_BLOCK_K) -> jax.Array:
-    """Flash attention over (B, S, H, D) inputs (GQA allowed)."""
+                    block_k: int = DEFAULT_BLOCK_K,
+                    window: int = 0) -> jax.Array:
+    """Flash attention over (B, S, H, D) inputs (GQA allowed).
+
+    ``window`` > 0 = sliding-window (Mistral-style) attention: query i
+    attends keys in [i − window + 1, i]. Requires ``causal``; k-blocks
+    outside the band are skipped, so cost is O(S·window)."""
+    if window < 0:
+        raise ValueError(f"window must be >= 0, got {window}")
+    if window and not causal:
+        raise ValueError("window > 0 requires causal=True")
     B, S, H, D = q.shape
     Hkv = k.shape[2]
     if S != k.shape[1] and causal:
@@ -426,5 +462,5 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     qt = jnp.transpose(q, (0, 2, 1, 3))
     kt = jnp.transpose(k, (0, 2, 1, 3))
     vt = jnp.transpose(v, (0, 2, 1, 3))
-    out = _flash_bhsd(qt, kt, vt, causal, bq, bk)
+    out = _flash_bhsd(qt, kt, vt, causal, bq, bk, window)
     return jnp.transpose(out, (0, 2, 1, 3))

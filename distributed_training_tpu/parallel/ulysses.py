@@ -48,7 +48,7 @@ from distributed_training_tpu.runtime import AXIS_SP, BATCH_AXES
 def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                       axis_name: str = AXIS_SP, causal: bool = True,
                       local_impl: str = "auto", block_q: int = 0,
-                      block_k: int = 0) -> jax.Array:
+                      block_k: int = 0, window: int = 0) -> jax.Array:
     """Sequence-parallel attention; call INSIDE shard_map.
 
     Per-device shards: q (B, S_local, H, D); k/v (B, S_local, Hkv, D),
@@ -64,7 +64,7 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     if sp == 1:
         return dot_product_attention(q, k, v, causal=causal,
                                      impl=local_impl, block_q=block_q,
-                                     block_k=block_k)
+                                     block_k=block_k, window=window)
     # Shapes here are per-shard: when a head axis (tp) also shards the
     # head dim, these are the per-tp-shard counts — which is exactly
     # what must divide by sp (the a2a swaps seq for heads within the
@@ -86,17 +86,20 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         return jax.lax.all_to_all(x, axis_name, split_axis=1,
                                   concat_axis=2, tiled=True)
 
+    # Window semantics survive the a2a: the local attention sees the
+    # FULL sequence (only heads are sharded), so the band is global.
     out = dot_product_attention(
         seq_to_heads(q), seq_to_heads(k), seq_to_heads(v),
         causal=causal, impl=local_impl, block_q=block_q,
-        block_k=block_k)
+        block_k=block_k, window=window)
     return heads_to_seq(out)
 
 
 def make_ulysses_attention(mesh: Mesh, causal: bool = True,
                            batch_axes=BATCH_AXES,
                            local_impl: str = "auto", block_q: int = 0,
-                           block_k: int = 0, head_axis=None):
+                           block_k: int = 0, head_axis=None,
+                           window: int = 0):
     """Build the shard_map'd Ulysses fn over global (B, S, H, D)
     arrays: batch over ``batch_axes``, sequence over ``sp``, heads
     over ``head_axis`` (tp) when given — the a2a then trades sequence
@@ -108,7 +111,8 @@ def make_ulysses_attention(mesh: Mesh, causal: bool = True,
     return shard_map(
         functools.partial(ulysses_attention, axis_name=AXIS_SP,
                           causal=causal, local_impl=local_impl,
-                          block_q=block_q, block_k=block_k),
+                          block_q=block_q, block_k=block_k,
+                          window=window),
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
