@@ -720,7 +720,9 @@ class Transformer:
 
     def _attend_cache(self, q, k_cache, v_cache, pos):
         """Single-position attention: q (B, 1, H, hd) against the cache
-        (B, Sm, Hkv, hd), keys at positions <= pos. GQA-grouped like
+        (B, Sm, Hkv, hd), keys at positions <= pos (and within
+        ``attention_window`` of pos when set — decode honors the same
+        band the training mask applies). GQA-grouped like
         ops.attention (hkv-major head order)."""
         c = self.cfg
         group = c.n_heads // c.n_kv_heads
@@ -729,7 +731,11 @@ class Transformer:
         logits = jnp.einsum(
             "bhgd,bshd->bhgs", qg, k_cache,
             preferred_element_type=jnp.float32) * c.head_dim ** -0.5
-        mask = jnp.arange(Sm)[None, None, None, :] <= pos
+        idx = jnp.arange(Sm)[None, None, None, :]
+        mask = idx <= pos
+        if c.attention_window:
+            mask = jnp.logical_and(
+                mask, idx >= pos - (c.attention_window - 1))
         logits = jnp.where(mask, logits, -1e30)
         probs = jax.nn.softmax(logits, axis=-1)
         out = jnp.einsum("bhgs,bshd->bhgd",

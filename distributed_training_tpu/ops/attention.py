@@ -15,7 +15,6 @@ targets where MFU ≥ 0.4 requires a real attention path.
 
 from __future__ import annotations
 
-import functools
 
 import jax
 import jax.numpy as jnp
@@ -61,12 +60,6 @@ def _naive_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     out = jnp.einsum("bhgqk,bkhd->bqhgd", probs.astype(v.dtype), v,
                      preferred_element_type=jnp.float32)
     return rearrange(out, "b q hkv g d -> b q (hkv g) d").astype(q.dtype)
-
-
-@functools.partial(jax.jit, static_argnames=("causal", "impl"))
-def _jitted_naive(q, k, v, causal, impl):
-    del impl
-    return _naive_attention(q, k, v, causal)
 
 
 def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
