@@ -699,7 +699,8 @@ class Transformer:
 
     def flops_per_token(self, seq_len: int | None = None) -> float:
         """Fwd+bwd FLOPs/token: 6 * N_dense + attention quadratic term
-        (causal → half), the standard PaLM-appendix accounting."""
+        (causal → half; sliding window → the band's average width), the
+        standard PaLM-appendix accounting."""
         c = self.cfg
         S = seq_len or c.max_seq_len
         N = self.num_params()
@@ -708,7 +709,14 @@ class Transformer:
             expert_p = (c.moe_num_experts * 2 * c.d_model * c.d_ff
                         * c.n_layers)
             N = N - expert_p + expert_p * c.moe_top_k // c.moe_num_experts
-        attn = 12 * c.n_layers * c.d_model * S * 0.5
+        # Average live keys per query: causal = (S+1)/2 ~ S/2; with a
+        # window W, query i sees min(i+1, W) keys.
+        if c.attention_window:
+            W = min(c.attention_window, S)
+            avg_keys = W - W * (W - 1) / (2 * S)
+        else:
+            avg_keys = S * 0.5
+        attn = 12 * c.n_layers * c.d_model * avg_keys
         return 6.0 * N + attn
 
     def flops_per_sample(self) -> float:
@@ -723,7 +731,13 @@ class Transformer:
         (B, Sm, Hkv, hd), keys at positions <= pos (and within
         ``attention_window`` of pos when set — decode honors the same
         band the training mask applies). GQA-grouped like
-        ops.attention (hkv-major head order)."""
+        ops.attention (hkv-major head order).
+
+        The cache stays O(max_len) even under a window — masked slots
+        are computed then dropped. A rolling window-sized KV buffer
+        (dynamic_update_slice modulo window) is the decode-throughput
+        upgrade path if generation ever becomes a hot path; training
+        (the benchmarked path) is unaffected."""
         c = self.cfg
         group = c.n_heads // c.n_kv_heads
         B, Sm = k_cache.shape[0], k_cache.shape[1]
