@@ -37,10 +37,27 @@ MATRIX = [
     # knob variants at the ladder's center.
     (32, {"scan_unroll": 4}),
     (32, {"flash_block_q": 512, "flash_block_k": 512}),
+    # Full unroll turns the stacked-layer scan's dynamic slices into
+    # static offsets — XLA can then reuse buffers across layers
+    # instead of stacking residuals. If that kills the measured
+    # scan-stack duplication, batch 32 may fit with NO remat (zero
+    # recompute -> the highest MFU ceiling of any point here).
+    (32, {"scan_unroll": 12}),
+    (32, {"remat": False, "scan_unroll": 12}),
+    (16, {"remat": False, "scan_unroll": 12}),
     # selective remat trades +33% recompute for the biggest batches.
     (64, {"remat_policy": "selective"}),
 ]
-QUICK = MATRIX[:5]
+# The five highest-information points for a short healthy-chip window:
+# r2 anchor, the headline candidate, the no-remat full-unroll
+# hypothesis, and the batch ceiling probes.
+QUICK = [
+    (8, {"remat": False}),
+    (32, {}),
+    (32, {"remat": False, "scan_unroll": 12}),
+    (48, {}),
+    (64, {}),
+]
 
 
 def main() -> None:
