@@ -9,7 +9,8 @@ captures every tuning decision. Points that OOM or error emit an
 ``error`` line and the matrix continues.
 
     python benchmarks/tune_headline.py            # full matrix
-    python benchmarks/tune_headline.py --quick    # batches x remat only
+    python benchmarks/tune_headline.py --quick    # five-point short set
+    # (r2 anchor, headline candidate, no-remat full-unroll, ceilings)
 """
 
 from __future__ import annotations

@@ -39,6 +39,13 @@ DEFAULT_BLOCK_Q = 256
 DEFAULT_BLOCK_K = 256
 NEG_INF = -1e30
 
+# Every kernel here runs a (B, H, outer, inner) grid where only the
+# innermost dim carries accumulation order (fwd/dq: k-blocks; dkv:
+# q-blocks) — declaring the rest parallel lets Mosaic pipeline them.
+_DIM_SEMANTICS = pltpu.CompilerParams(
+    dimension_semantics=("parallel", "parallel", "parallel",
+                         "arbitrary"))
+
 
 def _block_needed(causal: bool, q_start, k_start, block_q: int,
                   block_k: int = 0, window: int = 0):
@@ -208,6 +215,7 @@ def _flash_fwd(q, k, v, *, causal, block_q, block_k, out_dtype=None,
             pltpu.VMEM((block_q, 1), jnp.float32),
             pltpu.VMEM((block_q, 1), jnp.float32),
         ],
+        compiler_params=_DIM_SEMANTICS,
         interpret=not _platform_is_tpu(),
     )(q, k, v)
     return out, lse
@@ -355,6 +363,7 @@ def _flash_bwd(q, k, v, out, lse, do, *, causal, block_q, block_k,
                                lambda b, h, qi, ki: (b, h, qi, 0)),
         out_shape=jax.ShapeDtypeStruct((B, H, S, D), gdt or q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
+        compiler_params=_DIM_SEMANTICS,
         interpret=interp,
     )(q, k, v, do, lse, delta)
 
@@ -393,6 +402,7 @@ def _flash_bwd(q, k, v, out, lse, do, *, causal, block_q, block_k,
             pltpu.VMEM((block_k, D), jnp.float32),
             pltpu.VMEM((block_k, D), jnp.float32),
         ],
+        compiler_params=_DIM_SEMANTICS,
         interpret=interp,
     )(q, k, v, do, lse, delta)
     if reps > 1:
