@@ -33,6 +33,11 @@ _BATCH_ENV = os.environ.get("DTT_BENCH_BATCH", "32")
 # gelu, ~+4% step FLOPs, and it unlocks batch 32 (4x the batch-8 r2
 # config). Sweeps override via measure(..., remat=False, ...).
 HEADLINE_MODEL_KWARGS = {"remat": True, "remat_policy": "mlp"}
+# Measured after the headline succeeds (same batch); best result wins.
+# Full unroll makes the stacked-layer slices static — if XLA then
+# reuses layer buffers instead of stacking residuals, no-remat (zero
+# recompute) may fit and beat the remat config.
+CONTENDER_MODEL_KWARGS = [{"remat": False, "scan_unroll": 12}]
 WARMUP_STEPS = 3
 TIMED_STEPS = 20
 PROBE_TIMEOUT_S = int(os.environ.get("DTT_BENCH_PROBE_TIMEOUT", "120"))
@@ -115,6 +120,27 @@ def _arm_watchdog():
         os._exit(1)
 
     t = threading.Timer(RUN_TIMEOUT_S, fire)
+    t.daemon = True
+    t.start()
+    return t
+
+
+CONTENDER_TIMEOUT_S = int(os.environ.get("DTT_BENCH_CONTENDER_TIMEOUT",
+                                         "600"))
+
+
+def _arm_salvage(result: dict):
+    """Timer that emits an already-measured result and exits CLEANLY
+    if a contender run wedges the process — the opposite failure
+    semantics of _arm_watchdog (which zeroes the round)."""
+    import threading
+
+    def fire():
+        _phase("salvage_fired", budget_s=CONTENDER_TIMEOUT_S)
+        print(json.dumps(result), flush=True)
+        os._exit(0)
+
+    t = threading.Timer(CONTENDER_TIMEOUT_S, fire)
     t.daemon = True
     t.start()
     return t
@@ -283,15 +309,39 @@ def main() -> None:
                 _phase("retry_smaller_batch", batch=batch)
     finally:
         watchdog.cancel()
-    mfu = m.pop("mfu")
-    result = {
-        "metric": "gpt2_125m_train_mfu_single_chip",
-        "value": round(mfu, 4),
-        "unit": "mfu",
-        "vs_baseline": round(mfu / 0.4, 4),
-        "detail": m,
-    }
-    print(json.dumps(result))
+
+    def _result(mm: dict) -> dict:
+        mm = dict(mm)
+        mfu = mm.pop("mfu")
+        return {
+            "metric": "gpt2_125m_train_mfu_single_chip",
+            "value": round(mfu, 4),
+            "unit": "mfu",
+            "vs_baseline": round(mfu / 0.4, 4),
+            "detail": mm,
+        }
+
+    # The headline config succeeded; also measure the contender
+    # configs at the same batch and report the best. Insurance for an
+    # untunable round (flaky chip): the driver's single run still
+    # picks the winner between the committed candidates. Contender
+    # failures only forfeit the comparison, never the evidence line —
+    # a salvage watchdog emits the ALREADY-VALID headline result if a
+    # contender wedges (the main watchdog would have zeroed it), and a
+    # contender must be loss-finite to win (a NaN run can be fast).
+    salvage = _arm_salvage(_result(m))
+    try:
+        for extra in CONTENDER_MODEL_KWARGS:
+            try:
+                _phase("contender", batch=batch, **extra)
+                cand = measure(batch, **extra)
+                if cand.get("loss_finite") and cand["mfu"] > m["mfu"]:
+                    m = cand
+            except Exception as e:  # noqa: BLE001
+                _phase("contender_failed", error=f"{type(e).__name__}")
+    finally:
+        salvage.cancel()
+    print(json.dumps(_result(m)))
 
 
 if __name__ == "__main__":

@@ -81,7 +81,9 @@ def test_bench_retries_smaller_batch_on_failure(monkeypatch, capsys):
     bench.main()
     out = capsys.readouterr().out.strip().splitlines()[-1]
     rec = json.loads(out)
-    assert calls == [32, 16, 8]
+    # 32 -> 16 -> 8 halving, then one contender config at the same
+    # batch (same fake mfu -> the primary result is kept).
+    assert calls == [32, 16, 8] + [8] * len(bench.CONTENDER_MODEL_KWARGS)
     assert rec["value"] == 0.5
     assert rec["detail"]["batch"] == 8
 
@@ -115,3 +117,48 @@ def test_bench_retries_smaller_batch_on_failure(monkeypatch, capsys):
     rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert rec["error"]["stage"] == "measure"
     assert calls == [32]
+
+
+def test_bench_contender_wins_when_faster(monkeypatch, capsys):
+    """The driver's single run reports the best of the committed
+    candidate configs; a losing or crashing contender never forfeits
+    the evidence line."""
+    import bench
+
+    monkeypatch.setattr(bench, "probe_backend", lambda: None)
+    monkeypatch.setattr(bench, "_resolve_batch", lambda: 16)
+
+    def fake_measure(batch, **kw):
+        if kw.get("scan_unroll") == 12:
+            return {"mfu": 0.61, "batch": batch, "loss_finite": True,
+                    "model_kwargs": kw}
+        return {"mfu": 0.5, "batch": batch, "loss_finite": True,
+                "model_kwargs": kw}
+
+    monkeypatch.setattr(bench, "measure", fake_measure)
+    bench.main()
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["value"] == 0.61
+    assert rec["detail"]["model_kwargs"].get("scan_unroll") == 12
+
+    # crashing contender -> primary still reported
+    def crashy(batch, **kw):
+        if kw.get("scan_unroll") == 12:
+            raise RuntimeError("contender exploded")
+        return {"mfu": 0.5, "batch": batch, "loss_finite": True}
+
+    monkeypatch.setattr(bench, "measure", crashy)
+    bench.main()
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["value"] == 0.5
+
+    # a faster-but-NaN contender must NOT win
+    def nan_fast(batch, **kw):
+        if kw.get("scan_unroll") == 12:
+            return {"mfu": 0.9, "batch": batch, "loss_finite": False}
+        return {"mfu": 0.5, "batch": batch, "loss_finite": True}
+
+    monkeypatch.setattr(bench, "measure", nan_fast)
+    bench.main()
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["value"] == 0.5
