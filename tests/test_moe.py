@@ -152,3 +152,38 @@ def test_moe_model_trains_routed(cpu8):
     m2 = trainer.train_step(batch)
     assert np.isfinite(float(m1["loss"]))
     assert float(m2["loss"]) < float(m1["loss"]) + 1.0
+
+
+def test_moe_composes_with_ulysses(cpu8):
+    """Routed MoE under Ulysses sequence parallelism: attention
+    re-shards (seq <-> heads) around an MLP whose token routing is
+    oblivious to the sp layout — losses must match plain dp."""
+    from distributed_training_tpu.config import Config
+    from distributed_training_tpu.data import (ShardedDataLoader,
+                                               SyntheticLMDataset)
+    from distributed_training_tpu.models.transformer import (
+        Transformer, TransformerConfig)
+    from distributed_training_tpu.runtime import fake_cpu_runtime
+    from distributed_training_tpu.train.trainer import Trainer
+
+    losses = {}
+    for tag, ndev, axes, impl in (("dp", 2, {}, "naive"),
+                                  ("sp", 8, {"sp": 4}, "ulysses")):
+        rt = fake_cpu_runtime(ndev, **axes)
+        cfg = Config()
+        cfg.train.batch_size = 2
+        cfg.train.total_epochs = 1
+        cfg.train.log_every = 0
+        cfg.train.learning_rate = 0.01
+        model = Transformer(TransformerConfig(
+            vocab_size=64, d_model=32, n_layers=2, n_heads=4,
+            max_seq_len=16, dtype="float32", attention_impl=impl,
+            moe_num_experts=4, moe_top_k=2))
+        ds = SyntheticLMDataset(size=8, seq_len=16, vocab_size=64,
+                                seed=0)
+        loader = ShardedDataLoader(ds, rt, batch_size=2, shuffle=False)
+        trainer = Trainer(cfg, rt, model, loader)
+        losses[tag] = [float(trainer.train_step(b)["loss"])
+                       for b in loader.epoch(0)]
+    np.testing.assert_allclose(losses["dp"], losses["sp"],
+                               rtol=1e-5, atol=1e-6)
