@@ -41,11 +41,16 @@ CONTENDER_MODEL_KWARGS = [{"remat": False, "scan_unroll": 12}]
 WARMUP_STEPS = 3
 TIMED_STEPS = 20
 PROBE_TIMEOUT_S = int(os.environ.get("DTT_BENCH_PROBE_TIMEOUT", "120"))
-# A wedged axon tunnel has been observed to stay down 30-60 min and
-# then recover; the bench is the round's only perf evidence, so the
-# probe outlasts that window by default (10 x (120s + 90s) ~ 35 min).
 PROBE_ATTEMPTS = int(os.environ.get("DTT_BENCH_PROBE_ATTEMPTS", "10"))
 PROBE_BACKOFF_S = float(os.environ.get("DTT_BENCH_PROBE_BACKOFF", "90"))
+# Hard ceiling on TOTAL probe wall time. Round 3's lesson: per-attempt
+# limits alone let the loop run ~35 min, which outlasted the driver's
+# own kill budget — the process died from outside (rc=124) and the
+# "always emit the evidence JSON" guarantee never fired. The budget
+# must stay well under any plausible driver timeout, and the failure
+# line is emitted BEFORE exhaustion, by a daemon timer armed up front.
+PROBE_TOTAL_BUDGET_S = float(
+    os.environ.get("DTT_BENCH_PROBE_TOTAL_BUDGET", "480"))
 RUN_TIMEOUT_S = int(os.environ.get("DTT_BENCH_RUN_TIMEOUT", "1800"))
 
 
@@ -55,14 +60,18 @@ def _phase(name: str, **kv) -> None:
           flush=True)
 
 
-def _fail(stage: str, message: str) -> None:
-    print(json.dumps({
+def _failure_record(stage: str, message: str) -> dict:
+    return {
         "metric": "gpt2_125m_train_mfu_single_chip",
         "value": 0.0,
         "unit": "mfu",
         "vs_baseline": 0.0,
         "error": {"stage": stage, "message": message[:500]},
-    }))
+    }
+
+
+def _fail(stage: str, message: str) -> None:
+    print(json.dumps(_failure_record(stage, message)))
     sys.exit(1)
 
 
@@ -72,32 +81,66 @@ def probe_backend() -> None:
     runtime is sick (observed: ``make_c_api_client`` blocked >5 min), and
     once the main process is stuck in that C call no signal handler runs
     — so the probe happens in a child we can kill."""
+    import threading
+
+    # Armed BEFORE the first probe: even if a probe subprocess call
+    # itself wedges past its timeout (or the loop miscounts), the
+    # evidence line still goes out inside the budget. os._exit because
+    # the main thread may be blocked in an uninterruptible wait.
+    def _budget_fire():
+        _phase("probe_budget_expired", budget_s=PROBE_TOTAL_BUDGET_S)
+        print(json.dumps(_failure_record(
+            "probe_backend",
+            "accelerator backend unresponsive; total probe budget "
+            f"{PROBE_TOTAL_BUDGET_S}s expired")), flush=True)
+        os._exit(1)
+
+    budget_timer = threading.Timer(PROBE_TOTAL_BUDGET_S, _budget_fire)
+    budget_timer.daemon = True
+    budget_timer.start()
+    t_start = time.monotonic()
+
+    def _remaining() -> float:
+        return PROBE_TOTAL_BUDGET_S - (time.monotonic() - t_start)
+
     code = ("import jax; d = jax.devices(); "
             "import jax.numpy as jnp; "
             "x = (jnp.ones((256, 256)) @ jnp.ones((256, 256))).sum(); "
             "x.block_until_ready(); print(d[0].device_kind)")
+    attempt = 0
     for attempt in range(1, PROBE_ATTEMPTS + 1):
+        # Leave ~10s headroom so the subprocess timeout always trips
+        # before the budget timer would hard-exit mid-probe. The break
+        # gates on REMAINING budget, not the configured timeout — a
+        # short DTT_BENCH_PROBE_TIMEOUT must shorten probes, not skip
+        # them entirely.
+        if _remaining() < 15:
+            break
+        per_try = max(1.0, min(PROBE_TIMEOUT_S, _remaining() - 10))
         _phase("probe_backend", attempt=attempt,
-               timeout_s=PROBE_TIMEOUT_S)
+               timeout_s=round(per_try))
         try:
             out = subprocess.run(
                 [sys.executable, "-c", code], capture_output=True,
-                text=True, timeout=PROBE_TIMEOUT_S)
+                text=True, timeout=per_try)
             if out.returncode == 0:
                 kind = out.stdout.strip().splitlines()[-1]
                 _phase("probe_backend_ok", device_kind=repr(kind))
+                budget_timer.cancel()
                 return
             detail = (out.stderr or out.stdout).strip()[-300:]
             _phase("probe_backend_error", rc=out.returncode,
                    detail=repr(detail))
         except subprocess.TimeoutExpired:
             _phase("probe_backend_timeout")
-        if attempt < PROBE_ATTEMPTS:
+        if attempt < PROBE_ATTEMPTS and _remaining() > PROBE_BACKOFF_S + 15:
             _phase("probe_backoff", sleep_s=PROBE_BACKOFF_S)
             time.sleep(PROBE_BACKOFF_S)
+    budget_timer.cancel()
     _fail("probe_backend",
-          f"accelerator backend unresponsive after {PROBE_ATTEMPTS} "
-          f"probes of {PROBE_TIMEOUT_S}s")
+          f"accelerator backend unresponsive after {attempt} probes "
+          f"within {round(time.monotonic() - t_start)}s "
+          f"(budget {PROBE_TOTAL_BUDGET_S}s)")
 
 
 def _arm_watchdog():
@@ -109,14 +152,8 @@ def _arm_watchdog():
 
     def fire():
         _phase("watchdog_fired", budget_s=RUN_TIMEOUT_S)
-        print(json.dumps({
-            "metric": "gpt2_125m_train_mfu_single_chip",
-            "value": 0.0,
-            "unit": "mfu",
-            "vs_baseline": 0.0,
-            "error": {"stage": "watchdog",
-                      "message": f"run exceeded {RUN_TIMEOUT_S}s"},
-        }), flush=True)
+        print(json.dumps(_failure_record(
+            "watchdog", f"run exceeded {RUN_TIMEOUT_S}s")), flush=True)
         os._exit(1)
 
     t = threading.Timer(RUN_TIMEOUT_S, fire)
@@ -129,15 +166,18 @@ CONTENDER_TIMEOUT_S = int(os.environ.get("DTT_BENCH_CONTENDER_TIMEOUT",
                                          "600"))
 
 
-def _arm_salvage(result: dict):
+def _arm_salvage(holder: dict):
     """Timer that emits an already-measured result and exits CLEANLY
     if a contender run wedges the process — the opposite failure
-    semantics of _arm_watchdog (which zeroes the round)."""
+    semantics of _arm_watchdog (which zeroes the round). ``holder``
+    is a mutable {"result": ...} cell read at fire time, so a
+    contender that improved the best before a later one wedged still
+    gets reported (ADVICE r3: a snapshot here discarded wins)."""
     import threading
 
     def fire():
         _phase("salvage_fired", budget_s=CONTENDER_TIMEOUT_S)
-        print(json.dumps(result), flush=True)
+        print(json.dumps(holder["result"]), flush=True)
         os._exit(0)
 
     t = threading.Timer(CONTENDER_TIMEOUT_S, fire)
@@ -236,7 +276,11 @@ def run_sweep_point(batch: int, timed_steps: int = 10,
                     phase=lambda *a, **k: None, **model_kwargs)
         m["mfu"] = round(m["mfu"], 4)
     except Exception as e:  # noqa: BLE001 — sweeps survive OOM points
-        m = {"batch": batch, "model_kwargs": model_kwargs,
+        # Record the EFFECTIVE kwargs (same merge measure() applies) so
+        # an OOM row for {} reads as the headline config it actually
+        # ran, not the bare default (ADVICE r3).
+        m = {"batch": batch,
+             "model_kwargs": {**HEADLINE_MODEL_KWARGS, **model_kwargs},
              "error": f"{type(e).__name__}: {e}"[:300]}
     m["point_wall_s"] = round(time.perf_counter() - t0, 1)
     return m
@@ -278,9 +322,17 @@ def _resolve_batch() -> int:
 
 
 def _is_oom(e: Exception) -> bool:
+    """Real device-OOM signatures only. The previous bare "allocat"
+    substring matched any message mentioning "allocate" (e.g. a host
+    allocation hiccup), silently rerouting deterministic failures into
+    batch-halving and burning watchdog budget (ADVICE r3)."""
     msg = str(e).lower()
-    return ("resource_exhausted" in msg or "out of memory" in msg
-            or "allocat" in msg)
+    return ("resource_exhausted" in msg
+            or "out of memory" in msg
+            or "ran out of memory" in msg
+            or "failed to allocate" in msg
+            or "allocation failure" in msg
+            or ("hbm" in msg and "exceed" in msg))
 
 
 def main() -> None:
@@ -329,7 +381,8 @@ def main() -> None:
     # a salvage watchdog emits the ALREADY-VALID headline result if a
     # contender wedges (the main watchdog would have zeroed it), and a
     # contender must be loss-finite to win (a NaN run can be fast).
-    salvage = _arm_salvage(_result(m))
+    best = {"result": _result(m)}
+    salvage = _arm_salvage(best)
     try:
         for extra in CONTENDER_MODEL_KWARGS:
             try:
@@ -337,6 +390,7 @@ def main() -> None:
                 cand = measure(batch, **extra)
                 if cand.get("loss_finite") and cand["mfu"] > m["mfu"]:
                     m = cand
+                    best["result"] = _result(m)
             except Exception as e:  # noqa: BLE001
                 _phase("contender_failed", error=f"{type(e).__name__}")
     finally:

@@ -17,6 +17,7 @@ optional ReLU-hidden stack. Losses:
 from __future__ import annotations
 
 from dataclasses import dataclass, field
+from typing import ClassVar
 
 import jax
 import jax.numpy as jnp
@@ -31,6 +32,11 @@ class MLP:
     hidden_sizes: list[int] = field(default_factory=list)
     loss_name: str = "mse"
     dtype: str = "float32"
+    # Batch keys loss() consumes — trainers validate the dataset
+    # against this before jit so a model/dataset mismatch fails with a
+    # config-level message, not a KeyError inside the traced step.
+    # ClassVar: a contract of loss(), not a constructor hyperparameter.
+    batch_keys: ClassVar[tuple[str, ...]] = ("x", "y")
 
     @property
     def _dims(self) -> list[tuple[int, int]]:

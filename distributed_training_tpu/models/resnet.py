@@ -12,6 +12,7 @@ docs/parity.md.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
+from typing import ClassVar
 
 import jax
 import jax.numpy as jnp
@@ -53,6 +54,7 @@ class ResNet:
     stage_sizes: list[int] = field(default_factory=lambda: [2, 2, 2, 2])
     dtype: str = "float32"
     loss_name: str = "xent"
+    batch_keys: ClassVar[tuple[str, ...]] = ("x", "y")
 
     def _stages(self):
         chans = [self.width * (2 ** i) for i in range(len(self.stage_sizes))]

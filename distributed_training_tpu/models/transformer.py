@@ -219,6 +219,8 @@ def _layer_norm(x: jax.Array, scale: jax.Array, bias: jax.Array
 class Transformer:
     """Functional decoder-only transformer (Model protocol)."""
 
+    batch_keys: tuple[str, ...] = ("tokens",)
+
     def __init__(self, cfg: TransformerConfig):
         self.cfg = cfg
         self.mesh = None  # bound by the trainer for ring/ulysses

@@ -78,6 +78,19 @@ def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     """
     if impl in ("auto", "flash"):
         from distributed_training_tpu.ops import flash_attention as fa
+        # An EXPLICIT tile override that does not divide the sequence
+        # must raise, not silently reroute to naive — otherwise sweep
+        # rows measure the wrong kernel under the override's label
+        # (ADVICE r3; mirrors ring_attention's raise-don't-ignore).
+        if impl == "auto" and (block_q or block_k):
+            sq, sk = q.shape[1], k.shape[1]
+            if (block_q and sq % min(block_q, sq)) or (
+                    block_k and sk % min(block_k, sk)):
+                raise ValueError(
+                    f"explicit flash tile override (block_q={block_q}, "
+                    f"block_k={block_k}) does not divide seq lengths "
+                    f"(Sq={sq}, Sk={sk}); fix the override or pass "
+                    "impl='naive' explicitly")
         if fa.supported(q, k, v, block_q=block_q or 0,
                         block_k=block_k or 0) or impl == "flash":
             kw = {}

@@ -27,6 +27,7 @@ from __future__ import annotations
 import dataclasses
 import logging
 import math
+import warnings
 from abc import ABC, abstractmethod
 from typing import Any
 
@@ -332,7 +333,18 @@ def get_strategy(name: str, mesh_spec=None, **kwargs) -> ShardingStrategy:
     if name == "ddp":
         return DataParallel(**kwargs)
     if name == "zero1":
-        return ZeRO1(data_size=sizes.get("data_size", 1), **kwargs)
+        data_size = sizes.get("data_size", 1)
+        if data_size <= 1:
+            # ZeRO1 with one data shard degenerates to plain DDP
+            # (moments fully replicated) — a silent no-op that hides
+            # misconfiguration (ADVICE r3). Loud, not fatal: single
+            # -chip smoke runs of multi-chip configs are legitimate.
+            warnings.warn(
+                "parallel_strategy='zero1' with data_size<=1: optimizer"
+                " moments will be fully replicated (plain DDP). Pass a"
+                " mesh with dp*fsdp > 1 for ZeRO-1 to shard anything.",
+                stacklevel=2)
+        return ZeRO1(data_size=data_size, **kwargs)
     if name in ("fsdp", "hybrid"):
         return FullyShardedDataParallel(
             fsdp_size=sizes.get("fsdp_size", 1), **kwargs)

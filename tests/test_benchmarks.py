@@ -162,3 +162,57 @@ def test_bench_contender_wins_when_faster(monkeypatch, capsys):
     bench.main()
     rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert rec["value"] == 0.5
+
+
+def test_bench_dead_backend_emits_json_within_budget(tmp_path):
+    """A wedged backend must yield the parseable failure-JSON evidence
+    line INSIDE the total probe budget — round 3's per-attempt-only
+    limits let the probe loop outlast the driver's kill window (rc=124,
+    no evidence at all). Simulated wedge: a fake ``jax`` module that
+    sleeps forever, so every probe child hangs until its timeout."""
+    import time as _time
+
+    (tmp_path / "jax.py").write_text(
+        "import time\ntime.sleep(600)\n")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = f"{tmp_path}:{env.get('PYTHONPATH', '')}"
+    # The axon sitecustomize imports jax at interpreter start when this
+    # var is set — which would hang bench.py ITSELF on the fake jax
+    # instead of only the probe children.
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.update(
+        DTT_BENCH_PROBE_TIMEOUT="2",
+        DTT_BENCH_PROBE_BACKOFF="1",
+        DTT_BENCH_PROBE_ATTEMPTS="100",
+        DTT_BENCH_PROBE_TOTAL_BUDGET="20",
+    )
+    t0 = _time.monotonic()
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        capture_output=True, text=True, timeout=60, env=env)
+    elapsed = _time.monotonic() - t0
+    assert elapsed < 45, f"probe loop ran {elapsed:.0f}s on a 20s budget"
+    assert out.returncode == 1
+    # Probes must actually have been attempted (the hung fake-jax child
+    # timing out), not skipped by a miscomputed per-try floor.
+    assert "probe_backend_timeout" in out.stderr
+    rec = json.loads(out.stdout.strip().splitlines()[-1])
+    assert rec["value"] == 0.0
+    assert rec["error"]["stage"] == "probe_backend"
+
+
+def test_is_oom_classification():
+    """_is_oom matches real device-OOM signatures and nothing else —
+    the old bare "allocat" substring rerouted deterministic failures
+    into batch-halving (ADVICE r3)."""
+    import bench
+
+    assert bench._is_oom(RuntimeError(
+        "RESOURCE_EXHAUSTED: Out of memory while trying to allocate "
+        "1207959552 bytes"))
+    assert bench._is_oom(RuntimeError("ran out of memory on device"))
+    assert bench._is_oom(RuntimeError("Failed to allocate request"))
+    # NOT OOM: mentions allocation but is a different failure class
+    assert not bench._is_oom(RuntimeError(
+        "could not allocate a tracer: shape mismatch"))
+    assert not bench._is_oom(TypeError("bad shapes"))

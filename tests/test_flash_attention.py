@@ -109,3 +109,19 @@ def test_dispatch_auto_uses_flash_on_tpu_and_matches(monkeypatch):
     out_auto = dot_product_attention(q, k, v, causal=True, impl="auto")
     np.testing.assert_allclose(np.asarray(out_auto), np.asarray(out_flash),
                                rtol=2e-5, atol=2e-5)
+
+
+def test_dispatch_auto_rejects_non_dividing_tile_override():
+    """An explicit tile override that doesn't divide the sequence must
+    raise under impl='auto', not silently measure the naive path under
+    the override's label (ADVICE r3; mirrors ring's raise-don't-ignore)."""
+    import pytest
+
+    from distributed_training_tpu.ops.attention import dot_product_attention
+    q, k, v = rand_qkv(S=256)
+    with pytest.raises(ValueError, match="does not divide"):
+        dot_product_attention(q, k, v, impl="auto", block_q=192)
+    with pytest.raises(ValueError, match="does not divide"):
+        dot_product_attention(q, k, v, impl="auto", block_k=96)
+    # A dividing override stays legal.
+    dot_product_attention(q, k, v, impl="auto", block_q=128, block_k=128)

@@ -110,6 +110,14 @@ def test_registry():
         get_strategy("zorp")
 
 
+def test_zero1_warns_on_degenerate_data_size():
+    """zero1 without a >1 data axis is silently plain DDP — the caller
+    must be told the moment sharding is inactive (ADVICE r3)."""
+    with pytest.warns(UserWarning, match="fully replicated"):
+        s = get_strategy("zero1")
+    assert s.name == "zero1"
+
+
 def test_zero1_shards_moments_replicates_params(cpu8):
     """ZeRO-1: params replicated (DDP layout), Adam moments sharded
     over the data axes; the loss trajectory must be bit-identical to
