@@ -107,7 +107,7 @@ def probe_backend() -> None:
             "import jax.numpy as jnp; "
             "x = (jnp.ones((256, 256)) @ jnp.ones((256, 256))).sum(); "
             "x.block_until_ready(); print(d[0].device_kind)")
-    attempt = 0
+    probes_run = 0
     for attempt in range(1, PROBE_ATTEMPTS + 1):
         # Leave ~10s headroom so the subprocess timeout always trips
         # before the budget timer would hard-exit mid-probe. The break
@@ -117,6 +117,7 @@ def probe_backend() -> None:
         if _remaining() < 15:
             break
         per_try = max(1.0, min(PROBE_TIMEOUT_S, _remaining() - 10))
+        probes_run += 1
         _phase("probe_backend", attempt=attempt,
                timeout_s=round(per_try))
         try:
@@ -138,7 +139,7 @@ def probe_backend() -> None:
             time.sleep(PROBE_BACKOFF_S)
     budget_timer.cancel()
     _fail("probe_backend",
-          f"accelerator backend unresponsive after {attempt} probes "
+          f"accelerator backend unresponsive after {probes_run} probes "
           f"within {round(time.monotonic() - t_start)}s "
           f"(budget {PROBE_TOTAL_BUDGET_S}s)")
 

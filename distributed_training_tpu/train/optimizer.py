@@ -32,12 +32,29 @@ def build_schedule(cfg: TrainConfig, total_steps: int):
     return sched
 
 
+# Leaf names that never decay regardless of rank: the Transformer
+# stacks per-layer params with a leading L dim, so its LN scales/biases
+# are (L, D) and MLP biases (L, F) — a bare ndim>=2 test would decay
+# them, silently violating the documented "matrices" convention.
+_NO_DECAY_KEYS = frozenset({"b", "bi", "bo", "bias", "scale"})
+
+
 def _matrices_mask(params):
-    """Decay only >=2-D params: biases and LayerNorm scales/offsets are
-    excluded (the standard transformer convention; embeddings, being
-    matrices, do decay under this heuristic)."""
+    """Decay only matmul-participating params: biases and LayerNorm
+    scales/offsets are excluded (the standard transformer convention;
+    embeddings, being matrices, do decay under this heuristic). A leaf
+    decays iff it is >=2-D AND its key is not a bias/scale name —
+    name-aware because stacked per-layer 1-D params carry a leading
+    layer dim (pinned vs torch in tests/test_torch_parity.py)."""
     import jax
-    return jax.tree.map(lambda p: getattr(p, "ndim", 0) >= 2, params)
+
+    def decide(path, p):
+        last = path[-1]
+        key = getattr(last, "key", None) or getattr(last, "name", "")
+        return (getattr(p, "ndim", 0) >= 2
+                and str(key) not in _NO_DECAY_KEYS)
+
+    return jax.tree_util.tree_map_with_path(decide, params)
 
 
 def build_optimizer(cfg: TrainConfig,

@@ -157,16 +157,18 @@ class Trainer:
         # (e.g. model=byte_lm with the default regression dataset)
         # otherwise dies as a bare KeyError inside the jitted step.
         need = set(getattr(model, "batch_keys", ()) or ())
-        ds = getattr(loader, "dataset", None)
-        if need and ds is not None and len(ds) > 0:
-            have = set(ds.batch(np.array([0])).keys())
-            if not need <= have:
-                raise ValueError(
-                    f"model expects batch keys {sorted(need)} but the "
-                    f"dataset yields {sorted(have)} — pick a matching "
-                    "train.dataset (LMs: synthetic_lm / bytes_file / "
-                    "memmap_tokens; regression: synthetic*; images: "
-                    "synthetic_images)")
+        for role, ldr in (("train", loader), ("eval", eval_loader)):
+            ds = getattr(ldr, "dataset", None)
+            if need and ds is not None and len(ds) > 0:
+                have = set(ds.batch(np.array([0])).keys())
+                if not need <= have:
+                    raise ValueError(
+                        f"model expects batch keys {sorted(need)} but "
+                        f"the {role} dataset yields {sorted(have)} — "
+                        "pick a matching train.dataset (LMs: "
+                        "synthetic_lm / bytes_file / memmap_tokens; "
+                        "regression: synthetic*; images: "
+                        "synthetic_images)")
         tcfg = cfg.train
         if tcfg.grad_accum_steps > 1 and \
                 loader.batch_size % tcfg.grad_accum_steps:
