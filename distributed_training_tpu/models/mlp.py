@@ -40,8 +40,8 @@ class MLP:
 
     @property
     def _dims(self) -> list[tuple[int, int]]:
-        dims = [self.input_size] + list(self.hidden_sizes) + \
-            [self.output_size]
+        dims = ([self.input_size] + list(self.hidden_sizes)
+                + [self.output_size])
         return list(zip(dims[:-1], dims[1:]))
 
     def init(self, rng: jax.Array):

@@ -118,8 +118,8 @@ class ResNet:
                             if "proj" in blk else x)
                 x = jax.nn.relu(h + shortcut)
         x = jnp.mean(x, axis=(1, 2))  # global average pool
-        logits = x @ params["head"]["w"].astype(x.dtype) \
-            + params["head"]["b"].astype(x.dtype)
+        logits = (x @ params["head"]["w"].astype(x.dtype)
+                  + params["head"]["b"].astype(x.dtype))
         return logits.astype(jnp.float32)
 
     def loss(self, params, batch, rng: jax.Array, train: bool = True):

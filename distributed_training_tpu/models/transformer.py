@@ -469,11 +469,13 @@ class Transformer:
             # The two (B, S, 4D) tensors here are deliberately
             # UN-named: under the "mlp" policy's allow-list they are
             # the only recompute (wi-matmul + gelu in backward).
-            u = jnp.einsum("bsd,df->bsf", h, m["wi"].astype(dt)) \
-                + m["bi"].astype(dt)
+            u = jnp.einsum(
+                "bsd,df->bsf", h, m["wi"].astype(dt)
+            ) + m["bi"].astype(dt)
             u = jax.nn.gelu(u)
-            mlp_out = jnp.einsum("bsf,fd->bsd", u, m["wo"].astype(dt)) \
-                + m["bo"].astype(dt)
+            mlp_out = jnp.einsum(
+                "bsf,fd->bsd", u, m["wo"].astype(dt)
+            ) + m["bo"].astype(dt)
             aux = jnp.zeros((), jnp.float32)
         if drop is not None:
             mlp_out = drop(mlp_out,
@@ -785,8 +787,9 @@ class Transformer:
             u = jax.nn.gelu(jnp.einsum("bsd,df->bsf", h,
                                        m["wi"].astype(dt))
                             + m["bi"].astype(dt))
-            mlp_out = jnp.einsum("bsf,fd->bsd", u, m["wo"].astype(dt)) \
-                + m["bo"].astype(dt)
+            mlp_out = jnp.einsum(
+                "bsf,fd->bsd", u, m["wo"].astype(dt)
+            ) + m["bo"].astype(dt)
         return x + mlp_out, k_cache, v_cache
 
     def _lm_head(self, params, x_last):
@@ -876,8 +879,9 @@ class Transformer:
                 x = params["tok_embed"][tok][:, None, :].astype(
                     jnp.dtype(c.dtype))
                 if c.pos_encoding == "learned":
-                    x = x + params["pos_embed"][pos][None, None, :] \
-                        .astype(x.dtype)
+                    x = x + params["pos_embed"][pos][
+                        None, None, :
+                    ].astype(x.dtype)
 
                 def layer_body(xc, inp):
                     layer, kc, vc = inp
@@ -999,15 +1003,17 @@ def _moe_mlp_routed(h, mlp, c: TransformerConfig):
     # (G, g, k, E) -> slot-major (G, k·g, E): all slot-0 rows first, so
     # the running count gives slot 0 strictly higher buffer priority.
     oh = onehot.transpose(0, 2, 1, 3).reshape(G, k * g, E)
-    pos = (jnp.cumsum(oh, axis=1) * oh - 1.0) \
-        .astype(jnp.int32)                            # (G, k·g, E)
+    pos = (jnp.cumsum(oh, axis=1) * oh - 1.0).astype(
+        jnp.int32
+    )                                                 # (G, k·g, E)
     # one_hot maps out-of-range indices to the zero vector, which IS
     # the drop: unselected entries (pos == -1) and capacity overflow
     # (pos >= C) land in no buffer slot.
     slot = jax.nn.one_hot(pos, C, dtype=jnp.float32)  # (G, k·g, E, C)
     w = topv.transpose(0, 2, 1).reshape(G, k * g)     # slot-major wts
-    combine = jnp.einsum("gt,gtec->gtec", w, slot) \
-        .reshape(G, k, g, E, C).sum(axis=1)           # (G, g, E, C)
+    combine = (jnp.einsum("gt,gtec->gtec", w, slot)
+               .reshape(G, k, g, E, C)
+               .sum(axis=1))                          # (G, g, E, C)
     dispatch = combine > 0.0
 
     expert_in = jnp.einsum("gsec,gsd->gecd", dispatch.astype(dt), x)

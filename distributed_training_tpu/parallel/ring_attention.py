@@ -94,8 +94,9 @@ def _flash_block_ok(q, k, block_impl: str, block_q: int = 0,
     how sweeps misattribute their own measurements."""
     from distributed_training_tpu.ops import flash_attention as fa
     S, Sk = q.shape[1], k.shape[1]
-    if (block_q and S % min(block_q, S)) or \
-            (block_k and Sk % min(block_k, Sk)):
+    if (block_q and S % min(block_q, S)) or (
+        block_k and Sk % min(block_k, Sk)
+    ):
         raise ValueError(
             f"flash tile overrides ({block_q}, {block_k}) do not "
             f"divide the local shard lengths ({S}, {Sk})")
@@ -333,8 +334,9 @@ def _ring_core_bwd(axis_name, causal, block_impl, block_q, block_k,
         qt, dot, do_g = _bhsd(q), _bhsd(do), None
     else:
         qt = dot = None
-        do_g = do_f.reshape(B, S, Hkv, group, D) \
-            .transpose(0, 2, 3, 1, 4)
+        do_g = do_f.reshape(B, S, Hkv, group, D).transpose(
+            0, 2, 3, 1, 4
+        )
 
     def block_grads(kv, mode):
         if use_flash:
@@ -413,8 +415,9 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         # the ring's custom VJP). The raise-don't-ignore contract on
         # tile overrides still applies.
         S, Sk = q.shape[1], k.shape[1]
-        if (block_q and S % min(block_q, S)) or \
-                (block_k and Sk % min(block_k, Sk)):
+        if (block_q and S % min(block_q, S)) or (
+            block_k and Sk % min(block_k, Sk)
+        ):
             raise ValueError(
                 f"flash tile overrides ({block_q}, {block_k}) do not "
                 f"divide the local shard lengths ({S}, {Sk})")

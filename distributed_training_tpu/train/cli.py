@@ -71,8 +71,9 @@ def main(argv: list[str] | None = None) -> int:
     )
     eval_loader = None
     if cfg.train.eval_fraction > 0:
-        from distributed_training_tpu.data.datasets import \
-            train_eval_split
+        from distributed_training_tpu.data.datasets import (
+            train_eval_split,
+        )
         dataset, eval_ds = train_eval_split(
             dataset, cfg.train.eval_fraction, seed=cfg.train.seed,
             multiple_of=cfg.train.batch_size * rt.data_shard_count)

@@ -170,8 +170,8 @@ class Trainer:
                         "regression: synthetic*; images: "
                         "synthetic_images)")
         tcfg = cfg.train
-        if tcfg.grad_accum_steps > 1 and \
-                loader.batch_size % tcfg.grad_accum_steps:
+        if (tcfg.grad_accum_steps > 1
+                and loader.batch_size % tcfg.grad_accum_steps):
             # The strided microbatch split is zero-communication only
             # when each shard's rows divide evenly into the stride
             # classes; otherwise GSPMD would silently reshard the whole
@@ -389,9 +389,9 @@ class Trainer:
                 logger.info("epoch %d | mean_loss %.6f", epoch,
                             summary["mean_loss"])
             eval_every = self.cfg.train.eval_every
-            if self.eval_loader is not None and eval_every and \
-                    (epoch + 1) % eval_every == 0 and \
-                    not self._stop_agreed:
+            if (self.eval_loader is not None and eval_every
+                    and (epoch + 1) % eval_every == 0
+                    and not self._stop_agreed):
                 val_loss = self.evaluate(self.eval_loader.epoch(epoch))
                 summary["val_loss"] = val_loss
                 # Unthrottled: epoch-end eval must never be dropped by

@@ -168,8 +168,9 @@ def grad_global_norm_by_module(grads: Any) -> dict[str, float]:
 
 def summarize_state(state: Any) -> dict:
     """One-call health summary: finiteness + basic scale stats."""
-    params = state["params"] if isinstance(state, dict) and \
-        "params" in state else state
+    params = (state["params"]
+              if isinstance(state, dict) and "params" in state
+              else state)
     nonfinite = check_finite(params)
     norms = grad_global_norm_by_module(params)
     return {"nonfinite": nonfinite, "param_norms": norms,
