@@ -166,3 +166,31 @@ def test_zero1_shards_moments_replicates_params(cpu8):
                if hasattr(leaf, "sharding")]
     assert any(spec != () and any(ax is not None for ax in spec)
                for spec in m_specs), m_specs
+
+    # PHYSICAL layout check (VERDICT r3 item 8): the arrays in z.state
+    # came out of the COMPILED train step, so their shardings are the
+    # executable's actual output layouts — not the trainer's request.
+    # If XLA had silently degraded ZeRO-1 to replicated moments, each
+    # device would hold the full array; sharded 8-way it holds 1/8.
+    def device_frac(leaf):
+        return leaf.addressable_shards[0].data.nbytes / leaf.nbytes
+
+    opt_leaves = [x for x in jax.tree.leaves(z.state["opt_state"])
+                  if hasattr(x, "addressable_shards") and x.ndim >= 2]
+    assert opt_leaves, "no array moment leaves found"
+    sharded_ids = {id(x) for x in opt_leaves
+                   if device_frac(x) <= 1 / 8 + 1e-9}
+    # Every >=2-D moment (mu and nu for each matmul weight) must be
+    # physically 8-way sharded at min_shard_elems=1.
+    assert len(sharded_ids) == len(opt_leaves), [
+        (x.shape, str(x.sharding.spec)) for x in opt_leaves
+        if id(x) not in sharded_ids]
+    # And the aggregate opt-state HBM per device is ~1/8 of replicated
+    # (scalars/count stay replicated; they are noise at this size).
+    total = sum(x.nbytes for x in opt_leaves)
+    per_dev = sum(x.addressable_shards[0].data.nbytes
+                  for x in opt_leaves)
+    assert per_dev <= total / 8 * 1.05
+    # Params, by contrast, are physically replicated (DDP layout).
+    p_leaf = jax.tree.leaves(z.state["params"])[0]
+    assert device_frac(p_leaf) == 1.0
