@@ -1,0 +1,30 @@
+#!/bin/bash
+# Background TPU health probe loop. Writes benchmarks/state/chip_status
+# so on-chip work (bench, sweeps) can be fired the moment a wedged axon
+# tunnel recovers (the wedge playbook in .claude/skills/verify).
+#
+# Each probe runs in a killable subprocess: the wedge hangs inside a C
+# call that ignores SIGTERM, so timeout escalates to SIGKILL (-k) —
+# never probe in-process.
+STATE=/root/repo/benchmarks/state/chip_status
+LOG=/root/repo/benchmarks/state/probe_loop.log
+mkdir -p "$(dirname "$STATE")"
+OUT=$(mktemp /tmp/probe_out.XXXXXX)
+trap 'rm -f "$OUT"' EXIT
+while true; do
+  ts=$(date -u +%H:%M:%S)
+  timeout -k 10 150 env PYTHONPATH=/root/repo:/root/.axon_site python -c "
+import jax, jax.numpy as jnp
+d = jax.devices()
+x = jnp.ones((512,512), dtype=jnp.bfloat16)
+(x@x).block_until_ready()
+print('OK', d[0].platform)
+" >"$OUT" 2>&1
+  rc=$?
+  if [ $rc -eq 0 ] && grep -q "OK tpu" "$OUT"; then
+    echo "ALIVE $ts" > "$STATE"; echo "$ts ALIVE" >> "$LOG"
+  else
+    echo "WEDGED $ts rc=$rc" > "$STATE"; echo "$ts WEDGED rc=$rc" >> "$LOG"
+  fi
+  sleep 120
+done

@@ -1,0 +1,131 @@
+"""Exercise infrastructure/gcp/scripts/launch.sh command assembly.
+
+The reference's cloud bootstrap shipped broken-at-launch because nothing
+ever executed it (SURVEY.md §8 B1 — cloud-init.tftpl launches an
+entrypoint that does not exist). This framework's launcher is therefore
+tested, not trusted: a fake ``gcloud`` on PATH records every invocation
+and the assertions pin the fan-out flags, the stop-before-launch
+ordering, and the double-quoting contract that carries overrides intact
+across the two shell hops (local shell → remote login shell → inner
+root bash).
+"""
+
+import os
+import stat
+import subprocess
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LAUNCH = os.path.join(REPO, "infrastructure", "gcp", "scripts",
+                      "launch.sh")
+
+
+def test_launch_sh_bash_syntax():
+    """bash -n: the script parses (poor man's shellcheck; the real one
+    is not installed in this image)."""
+    subprocess.run(["bash", "-n", LAUNCH], check=True)
+
+
+def _run_with_fake_gcloud(tmp_path, args):
+    """Run launch.sh with a PATH-shadowing gcloud that logs its argv
+    (NUL-separated so embedded spaces/quotes are reconstructable)."""
+    calls = tmp_path / "calls"
+    calls.mkdir()
+    fake = tmp_path / "bin" / "gcloud"
+    fake.parent.mkdir()
+    fake.write_text(
+        "#!/usr/bin/env bash\n"
+        f'f="{calls}/$(date +%s%N)-$$"\n'
+        'printf "%s\\0" "$@" > "$f"\n')
+    fake.chmod(fake.stat().st_mode | stat.S_IEXEC)
+    env = dict(os.environ)
+    env["PATH"] = f"{fake.parent}:{env['PATH']}"
+    proc = subprocess.run(["bash", LAUNCH, *args], env=env,
+                          capture_output=True, text=True, timeout=60)
+    recorded = []
+    for name in sorted(os.listdir(calls)):
+        blob = (calls / name).read_bytes().decode()
+        recorded.append(blob.rstrip("\0").split("\0"))
+    return proc, recorded
+
+
+def test_launch_sh_two_phase_fanout(tmp_path):
+    proc, calls = _run_with_fake_gcloud(
+        tmp_path, ["my-pod", "us-central2-b",
+                   "train.parallel_strategy=fsdp"])
+    assert proc.returncode == 0, proc.stderr
+    assert len(calls) == 2, calls
+
+    stop, launch = calls
+    for argv in (stop, launch):
+        # Pod-wide fan-out over every worker of the named pod.
+        assert argv[:6] == ["compute", "tpus", "tpu-vm", "ssh",
+                            "my-pod", "--zone"]
+        assert argv[6] == "us-central2-b"
+        assert "--worker=all" in argv
+
+    # Phase 1 stops (and waits out) any previous trainer; its pkill
+    # pattern must not be able to match its own argv (bracket trick).
+    stop_cmd = stop[-1]
+    assert "pkill" in stop_cmd
+    assert "[m]ultigpu_multi_node.py" in stop_cmd
+    assert "multigpu_multi_node.py" not in stop_cmd.replace(
+        "[m]ultigpu_multi_node.py", "")
+    # Phase 2 launches the reference-named entrypoint under nohup.
+    launch_cmd = launch[-1]
+    assert "multigpu_multi_node.py" in launch_cmd
+    assert "DTT_AUTO_DISTRIBUTED=1" in launch_cmd
+    assert "train.parallel_strategy=fsdp" in launch_cmd
+
+    # The operator gets the log-tailing hint.
+    assert "tail -f /var/log/dtt-train.log" in proc.stdout
+
+
+def test_launch_sh_overrides_survive_quoting(tmp_path):
+    """An override containing spaces and quotes must arrive inside the
+    remote bash -c payload still as one argument (%q round-trip)."""
+    tricky = "run.experiment_name=my exp\"q'uote"
+    proc, calls = _run_with_fake_gcloud(
+        tmp_path, ["pod", "zone-x", tricky])
+    assert proc.returncode == 0, proc.stderr
+    launch_cmd = calls[1][-1]
+    # The inner payload is %q-quoted for the remote bash -c. Unwrap it
+    # exactly as the remote root shell would and check the argument
+    # boundary: a correctly-quoted tricky override parses back to the
+    # original string as ONE argv element of the inner command line.
+    import re
+    m = re.search(r"bash -c (.+)$", launch_cmd, re.M)
+    assert m, launch_cmd
+    unwrapped = subprocess.run(
+        ["bash", "-c", f"printf '%s' {m.group(1).strip()}"],
+        capture_output=True, text=True)
+    assert tricky in subprocess.run(
+        ["bash", "-c",
+         f"eval 'set -- '{_shquote(_extract_args(unwrapped.stdout))};"
+         " printf '%s\\0' \"$@\""],
+        capture_output=True, text=True).stdout.split("\0"), (
+        unwrapped.stdout)
+
+
+def _extract_args(inner_cmd: str) -> str:
+    """Pull the override tail of the inner launch line (everything
+    after the entrypoint, before the log redirect)."""
+    start = inner_cmd.index("multigpu_multi_node.py") + len(
+        "multigpu_multi_node.py")
+    end = inner_cmd.index(" > /var/log/")
+    return inner_cmd[start:end]
+
+
+def _shquote(s: str) -> str:
+    import shlex
+    return shlex.quote(s)
+
+
+def test_launch_sh_usage_errors():
+    proc = subprocess.run(["bash", LAUNCH], capture_output=True,
+                          text=True)
+    assert proc.returncode != 0
+    assert "usage:" in proc.stderr
+    proc = subprocess.run(["bash", LAUNCH, "pod-only"],
+                          capture_output=True, text=True)
+    assert proc.returncode != 0
+    assert "usage:" in proc.stderr
