@@ -69,10 +69,12 @@ class TransformerConfig:
     attention_impl: str = "auto"
     # Sliding-window (Mistral-style) attention: query i attends keys
     # in [i − window + 1, i]. 0 = full causal. Flash kernels skip
-    # out-of-band blocks (O(S·window) FLOPs); composes with the
-    # single-device and Ulysses impls (the local attention there sees
-    # the full sequence); the ring's per-block geometry is different —
-    # refused rather than silently full-causal.
+    # out-of-band blocks (O(S·window) FLOPs); composes with every
+    # impl: single-device and Ulysses apply the band over the full
+    # local sequence; the ring maps it onto its per-block geometry in
+    # GLOBAL positions (out-of-window blocks skipped, the boundary
+    # block band-masked — the sequence-parallel option for windowed
+    # GQA models whose head counts rule out Ulysses).
     attention_window: int = 0
     # Flash-kernel tile overrides (0 → ops/flash_attention defaults);
     # exposed so the bench sweep can tune them on real hardware.
@@ -243,6 +245,12 @@ class Transformer:
 
     def _attention(self, q, k, v):
         c = self.cfg
+        # A window covering the whole (or more of the) sequence is
+        # mathematically plain causal; normalize to 0 so the dispatch
+        # keeps the fused/flash paths (windowed ring blocks run the
+        # einsum reference) and skips no-op band masks.
+        window = (c.attention_window
+                  if 0 < c.attention_window < q.shape[1] else 0)
         if c.attention_impl in ("ring", "ulysses"):
             if self.mesh is None:
                 raise ValueError(
@@ -275,7 +283,7 @@ class Transformer:
                         q, k, v, axis_name=AXIS_SP, causal=True,
                         block_q=c.flash_block_q,
                         block_k=c.flash_block_k,
-                        window=c.attention_window)
+                        window=window)
                 if c.n_kv_heads % (tp * sp) or c.n_heads % (tp * sp):
                     # Heads are the shard currency for BOTH tp and the
                     # Ulysses a2a — refuse up front with global counts
@@ -292,18 +300,17 @@ class Transformer:
                                             block_q=c.flash_block_q,
                                             block_k=c.flash_block_k,
                                             head_axis=head_ax,
-                                            window=c.attention_window)
+                                            window=window)
                 return fn(q, k, v)
             from distributed_training_tpu.parallel.ring_attention import (
                 make_ring_attention, ring_attention,
             )
-            # (only the ring reaches here — ulysses returned above)
-            if c.attention_window:
-                raise ValueError(
-                    "attention_window is not wired through the ring's "
-                    "per-block geometry; use attention_impl='ulysses' "
-                    "(full-sequence local attention) for windowed "
-                    "long-context")
+            # (only the ring reaches here — ulysses returned above).
+            # attention_window composes: the ring skips blocks behind
+            # the window and band-masks the boundary block in GLOBAL
+            # positions (parallel/ring_attention.py) — this is the
+            # sequence-parallel option for windowed GQA models whose
+            # head counts rule out Ulysses (H % (tp·sp) != 0).
             from distributed_training_tpu.runtime import (
                 AXIS_SP, AXIS_TP)
             if self._inside_pp:
@@ -315,19 +322,21 @@ class Transformer:
                 return ring_attention(q, k, v, axis_name=AXIS_SP,
                                       causal=True,
                                       block_q=c.flash_block_q,
-                                      block_k=c.flash_block_k)
+                                      block_k=c.flash_block_k,
+                                      window=window)
             sizes = self._mesh_axis_sizes()
             head_ax = AXIS_TP if sizes.get(AXIS_TP, 1) > 1 else None
             fn = make_ring_attention(self.mesh, causal=True,
                                      head_axis=head_ax,
                                      block_q=c.flash_block_q,
-                                     block_k=c.flash_block_k)
+                                     block_k=c.flash_block_k,
+                                     window=window)
             return fn(q, k, v)
         return dot_product_attention(q, k, v, causal=True,
                                      impl=c.attention_impl,
                                      block_q=c.flash_block_q,
                                      block_k=c.flash_block_k,
-                                     window=c.attention_window)
+                                     window=window)
 
     # -- init --------------------------------------------------------------
 

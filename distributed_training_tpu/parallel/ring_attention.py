@@ -58,19 +58,45 @@ from distributed_training_tpu.runtime import AXIS_SP, BATCH_AXES
 NEG_INF = -1e30
 
 
-def _block_attn_naive(q, k, v, mode: str):
+def _block_mask(Sq: int, Sk: int, mode: str, offset, window: int):
+    """Visibility mask (Sq, Sk) for one ring block pair.
+
+    ``offset`` = absolute query-start − absolute key-start (0 on the
+    diagonal, t·S_local for a block t ring steps in the past; may be a
+    traced scalar). Query row r sits at absolute position r + offset
+    relative to key column c: causal keeps ``c <= r + offset``, a
+    sliding window additionally needs ``c >= r + offset − (window−1)``.
+    Returns None when nothing is masked (pure-past block, no window).
+    """
+    rows = jnp.arange(Sq)[:, None] + offset
+    cols = jnp.arange(Sk)[None, :]
+    mask = None
+    if mode == "causal":
+        mask = cols <= rows
+    if window:
+        lower = cols >= rows - (window - 1)
+        mask = lower if mask is None else jnp.logical_and(mask, lower)
+    return mask
+
+
+def _block_attn_naive(q, k, v, mode: str, offset=None, window: int = 0):
     """XLA-einsum block attention → (out_norm (B,Sq,H,D) f32,
-    lse (B,H,Sq) f32). The numerics reference for the flash block."""
+    lse (B,H,Sq) f32). The numerics reference for the flash block.
+
+    ``offset``/``window``: ring-block geometry (see _block_mask);
+    ``offset=None`` keeps the historical single-pair alignment
+    ``Sk − Sq`` (queries end where keys end)."""
     B, Sq, H, D = q.shape
     Hkv = k.shape[2]
     group = H // Hkv
     qg = q.reshape(B, Sq, Hkv, group, D)
     s = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k,
                    preferred_element_type=jnp.float32) * (D ** -0.5)
-    if mode == "causal":
-        Sk = k.shape[1]
-        mask = (jnp.arange(Sk)[None, :]
-                <= (jnp.arange(Sq)[:, None] + (Sk - Sq)))
+    Sk = k.shape[1]
+    if offset is None:
+        offset = Sk - Sq
+    mask = _block_mask(Sq, Sk, mode, offset, window)
+    if mask is not None:
         s = jnp.where(mask[None, None, None], s, -jnp.inf)
     m = jnp.maximum(jnp.max(s, axis=-1), NEG_INF)    # (B,Hkv,g,Sq)
     p = jnp.exp(s - m[..., None])
@@ -177,9 +203,24 @@ def _ring_perm(sp: int):
     return [(i, (i + 1) % sp) for i in range(sp)]
 
 
+def _ring_branch(src, idx, t, S: int, window: int):
+    """Ring-step branch id: 0 = past block, 1 = diagonal, 2 = skip.
+
+    Blocks ahead of the queries are always skipped (causality). Under a
+    sliding window, a past block t steps back is additionally skipped
+    when even its NEWEST key (gap to the OLDEST local query:
+    (t−1)·S + 1 positions) falls outside the window — the FLOPs term
+    that makes windowed ring attention O(S·W/sp) per device instead of
+    O(S²/sp²)·sp."""
+    past = jnp.where(src < idx, 0, 2)
+    if window:
+        past = jnp.where((t - 1) * S + 1 <= window - 1, past, 2)
+    return jnp.where(src == idx, 1, past)
+
+
 def _ring_fwd_scan(q, k, v, axis_name: str, causal: bool,
                    block_impl: str, block_q: int = 0,
-                   block_k: int = 0):
+                   block_k: int = 0, window: int = 0):
     """Full ring cycle of online-softmax accumulation. Returns the
     normalized output (B, S, H, D) in q.dtype and per-row logsumexp
     (B, H, S) fp32."""
@@ -188,16 +229,21 @@ def _ring_fwd_scan(q, k, v, axis_name: str, causal: bool,
     B, S, H, D = q.shape
     perm = _ring_perm(sp)
 
-    use_flash = _flash_block_ok(q, k, block_impl, block_q, block_k)
+    # Sliding-window blocks carry a positional offset mask the Pallas
+    # per-block kernels don't model yet; windowed rings run the einsum
+    # blocks (whole-block skipping still bounds the work by the window).
+    use_flash = (not window) and _flash_block_ok(q, k, block_impl,
+                                                 block_q, block_k)
     # Loop-invariant: hoisted here because XLA's while-loop LICM does
     # not lift computations out of lax.switch branch computations.
     qt = _bhsd(q) if use_flash else None
 
-    def block(kv, mode):
+    def block(kv, mode, offset):
         if use_flash:
             return _block_attn_flash(qt, kv[0], kv[1], mode,
                                      block_q, block_k)
-        return _block_attn_naive(q, kv[0], kv[1], mode)
+        return _block_attn_naive(q, kv[0], kv[1], mode,
+                                 offset=offset, window=window)
 
     out0 = jnp.zeros((B, S, H, D), jnp.float32)
     lse0 = jnp.full((B, H, S), NEG_INF, jnp.float32)
@@ -205,22 +251,23 @@ def _ring_fwd_scan(q, k, v, axis_name: str, causal: bool,
     def step(carry, t):
         k_cur, v_cur, out_acc, lse_acc = carry
         src = (idx - t) % sp
+        # Non-future blocks sit exactly t ring steps in the past, so
+        # the absolute query-start − key-start offset is t·S.
+        offset = t * S
 
         def full_block(kv):
-            return block(kv, "full")
+            return block(kv, "full", offset)
 
         def diag_block(kv):
-            return block(kv, "causal")
+            return block(kv, "causal", 0)
 
         def skip_block(kv):
-            del kv  # future block: zero contribution, no FLOPs
+            del kv  # out-of-view block: zero contribution, no FLOPs
             return jnp.zeros_like(out0), jnp.full_like(lse0, NEG_INF)
 
         if causal:
-            # 0: past (full), 1: diagonal (causal), 2: future (skip);
             # lax.switch keeps only one branch's FLOPs per step.
-            branch = jnp.where(src == idx, 1,
-                               jnp.where(src < idx, 0, 2))
+            branch = _ring_branch(src, idx, t, S, window)
             out_t, lse_t = jax.lax.switch(
                 branch, (full_block, diag_block, skip_block),
                 (k_cur, v_cur))
@@ -238,7 +285,8 @@ def _ring_fwd_scan(q, k, v, axis_name: str, causal: bool,
     return out_acc.astype(q.dtype), lse_acc
 
 
-def _block_grads_naive(q, k, v, do_g, lse, delta, mode: str):
+def _block_grads_naive(q, k, v, do_g, lse, delta, mode: str,
+                       offset=None, window: int = 0):
     """Einsum gradients of one KV block against the local queries, with
     the softmax recomputed from the saved FINAL logsumexp
     (``p = exp(s - lse)`` is the globally-normalized softmax — the
@@ -258,9 +306,10 @@ def _block_grads_naive(q, k, v, do_g, lse, delta, mode: str):
     delta_g = delta.reshape(B, Hkv, group, Sq)
     s = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k,
                    preferred_element_type=jnp.float32) * scale
-    if mode == "causal":
-        mask = (jnp.arange(Sk)[None, :]
-                <= (jnp.arange(Sq)[:, None] + (Sk - Sq)))
+    if offset is None:
+        offset = Sk - Sq
+    mask = _block_mask(Sq, Sk, mode, offset, window)
+    if mask is not None:
         s = jnp.where(mask[None, None, None], s, -jnp.inf)
     p = jnp.exp(s - lse_g[..., None])                # (B,Hkv,g,Sq,Sk)
     dv = jnp.einsum("bhgqk,bhgqd->bkhd", p, do_g,
@@ -291,23 +340,23 @@ def _block_grads_flash(qt, dot, k, v, lse, delta, mode: str,
     return _bhsd(dq), _bhsd(dk), _bhsd(dv)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
 def _ring_core(q, k, v, axis_name, causal, block_impl,
-               block_q=0, block_k=0):
+               block_q=0, block_k=0, window=0):
     out, _ = _ring_fwd_scan(q, k, v, axis_name, causal, block_impl,
-                            block_q, block_k)
+                            block_q, block_k, window)
     return out
 
 
 def _ring_core_fwd(q, k, v, axis_name, causal, block_impl,
-                   block_q=0, block_k=0):
+                   block_q=0, block_k=0, window=0):
     out, lse = _ring_fwd_scan(q, k, v, axis_name, causal, block_impl,
-                              block_q, block_k)
+                              block_q, block_k, window)
     return out, (q, k, v, out, lse)
 
 
 def _ring_core_bwd(axis_name, causal, block_impl, block_q, block_k,
-                   res, do):
+                   window, res, do):
     """Reverse ring: KV blocks make a second full rotation; each step
     recomputes that block's softmax and adds its dk/dv contribution into
     accumulators that TRAVEL WITH the block — after sp rotations the
@@ -329,7 +378,8 @@ def _ring_core_bwd(axis_name, causal, block_impl, block_q, block_k,
     # Loop-invariant per-path precomputes, hoisted out of the scan
     # (XLA's while-loop LICM does not lift out of switch branches):
     # flash wants (B,H,S,D) q/dO; the einsum path wants grouped dO.
-    use_flash = _flash_block_ok(q, k, block_impl, block_q, block_k)
+    use_flash = (not window) and _flash_block_ok(q, k, block_impl,
+                                                 block_q, block_k)
     if use_flash:
         qt, dot, do_g = _bhsd(q), _bhsd(do), None
     else:
@@ -338,12 +388,12 @@ def _ring_core_bwd(axis_name, causal, block_impl, block_q, block_k,
             0, 2, 3, 1, 4
         )
 
-    def block_grads(kv, mode):
+    def block_grads(kv, mode, offset):
         if use_flash:
             return _block_grads_flash(qt, dot, kv[0], kv[1], lse,
                                       delta, mode, block_q, block_k)
         return _block_grads_naive(q, kv[0], kv[1], do_g, lse, delta,
-                                  mode)
+                                  mode, offset=offset, window=window)
 
     dq0 = jnp.zeros((B, S, H, D), jnp.float32)
     dk0 = jnp.zeros(k.shape, jnp.float32)
@@ -352,20 +402,20 @@ def _ring_core_bwd(axis_name, causal, block_impl, block_q, block_k,
     def step(carry, t):
         k_cur, v_cur, dq_acc, dk_acc, dv_acc = carry
         src = (idx - t) % sp
+        offset = t * S
 
         def full_block(kv):
-            return block_grads(kv, "full")
+            return block_grads(kv, "full", offset)
 
         def diag_block(kv):
-            return block_grads(kv, "causal")
+            return block_grads(kv, "causal", 0)
 
         def skip_block(kv):
             del kv
             return dq0, dk0, dv0
 
         if causal:
-            branch = jnp.where(src == idx, 1,
-                               jnp.where(src < idx, 0, 2))
+            branch = _ring_branch(src, idx, t, S, window)
             dq_t, dk_t, dv_t = jax.lax.switch(
                 branch, (full_block, diag_block, skip_block),
                 (k_cur, v_cur))
@@ -395,7 +445,8 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                    axis_name: str = AXIS_SP,
                    causal: bool = True,
                    block_impl: str = "auto",
-                   block_q: int = 0, block_k: int = 0) -> jax.Array:
+                   block_q: int = 0, block_k: int = 0,
+                   window: int = 0) -> jax.Array:
     """Sequence-parallel attention; call INSIDE shard_map.
 
     Shapes are per-device shards: q/k/v (B, S_local, H|Hkv, D) where the
@@ -406,7 +457,35 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     einsum reference; "naive"/"flash" force a path. ``block_q``/
     ``block_k`` override the flash tiles (0 → module defaults; must
     divide the local shard — raises rather than silently ignore).
+
+    ``window > 0``: sliding-window (Mistral-style) attention in GLOBAL
+    positions — query i attends keys [i − window + 1, i] across shard
+    boundaries. Ring blocks entirely behind the window are skipped
+    (work per device is O(S_local · window), not O(S_local · S)); the
+    boundary block gets an offset band mask. Windowed blocks run the
+    einsum path (the per-block flash kernels don't model the offset
+    mask yet). Requires ``causal=True``.
     """
+    if window and not causal:
+        raise ValueError("window > 0 requires causal=True")
+    if window < 0:
+        raise ValueError(f"window must be >= 0, got {window}")
+    if window:
+        # Windowed blocks run the einsum path; the raise-don't-ignore
+        # contract on explicit kernel config still holds — a silently
+        # demoted sweep misattributes its own measurements.
+        if block_impl == "flash":
+            raise ValueError(
+                "block_impl='flash' is unsupported with window > 0 "
+                "(the per-block flash kernels don't model the offset "
+                "band mask); use block_impl='auto' or 'naive'")
+        S, Sk = q.shape[1], k.shape[1]
+        if (block_q and S % min(block_q, S)) or (
+            block_k and Sk % min(block_k, Sk)
+        ):
+            raise ValueError(
+                f"flash tile overrides ({block_q}, {block_k}) do not "
+                f"divide the local shard lengths ({S}, {Sk})")
     sp = jax.lax.axis_size(axis_name)
 
     if sp == 1:
@@ -422,18 +501,20 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                 f"flash tile overrides ({block_q}, {block_k}) do not "
                 f"divide the local shard lengths ({S}, {Sk})")
         out, _ = _block_attn_naive(q, k, v,
-                                   "causal" if causal else "full")
+                                   "causal" if causal else "full",
+                                   window=window)
         return out.astype(q.dtype)
 
     return _ring_core(q, k, v, axis_name, causal, block_impl,
-                      block_q, block_k)
+                      block_q, block_k, window)
 
 
 def make_ring_attention(mesh: Mesh, causal: bool = True,
                         batch_axes=BATCH_AXES,
                         head_axis: str | None = None,
                         block_impl: str = "auto",
-                        block_q: int = 0, block_k: int = 0):
+                        block_q: int = 0, block_k: int = 0,
+                        window: int = 0):
     """Build the shard_map'd ring-attention fn over global (B, S, H, D)
     arrays: batch over ``batch_axes``, sequence over ``sp``, heads over
     ``head_axis`` (pass ``tp`` to compose SP with tensor parallelism).
@@ -442,7 +523,8 @@ def make_ring_attention(mesh: Mesh, causal: bool = True,
     return shard_map(
         functools.partial(ring_attention, axis_name=AXIS_SP,
                           causal=causal, block_impl=block_impl,
-                          block_q=block_q, block_k=block_k),
+                          block_q=block_q, block_k=block_k,
+                          window=window),
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
@@ -466,9 +548,11 @@ def usable_batch_axes(mesh: Mesh, batch: int,
 
 def ring_attention_global(q: jax.Array, k: jax.Array, v: jax.Array,
                           mesh: Mesh, causal: bool = True,
-                          batch_axes=BATCH_AXES) -> jax.Array:
+                          batch_axes=BATCH_AXES,
+                          window: int = 0) -> jax.Array:
     """Convenience entry for tests/eager use."""
     fn = make_ring_attention(
         mesh, causal=causal,
-        batch_axes=usable_batch_axes(mesh, q.shape[0], batch_axes))
+        batch_axes=usable_batch_axes(mesh, q.shape[0], batch_axes),
+        window=window)
     return jax.jit(fn)(q, k, v)

