@@ -1,0 +1,122 @@
+#!/usr/bin/env python
+"""Measured tokens/sec for the BASELINE 1B path on ONE chip.
+
+Runs the FULL transformer_1b (24 layers, d=2048, untied rope — not the
+shrunken test variant) on a single v5e per the plan
+benchmarks/plan_memory.py validates: adafactor (factored second moment
+~2% of params — AdamW's 10.5 GiB of fp32 moments cannot share 16 GiB
+HBM with 5.3 GiB params + 5.3 GiB grads at step peak) and full
+rematerialization. fsdp=1 is expected on one chip; the deliverable is
+the measured config path, not scale.
+
+Prints one JSON line; an OOM degrades seq_len 1024 → 512 and finally
+swaps adafactor for SGD before giving up (each fallback is recorded).
+
+    PYTHONPATH=/root/repo:/root/.axon_site python \
+        benchmarks/bench_1b_single_chip.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+
+from bench import _is_oom  # noqa: E402
+
+ATTEMPTS = [
+    dict(seq_len=1024, optimizer="adafactor", offload=False),
+    dict(seq_len=512, optimizer="adafactor", offload=False),
+    dict(seq_len=512, optimizer="sgd", offload=False),
+]
+STEPS = int(os.environ.get("DTT_1B_STEPS", "5"))
+WARMUP = int(os.environ.get("DTT_1B_WARMUP", "2"))
+
+
+def run(seq_len: int, optimizer: str, offload: bool) -> dict:
+    import jax
+
+    from distributed_training_tpu.config import Config
+    from distributed_training_tpu.data import (ShardedDataLoader,
+                                               SyntheticLMDataset)
+    from distributed_training_tpu.models import build_model
+    from distributed_training_tpu.runtime import initialize_runtime
+    from distributed_training_tpu.train.trainer import Trainer
+    from distributed_training_tpu.utils.metrics import peak_flops_per_chip
+
+    cfg = Config()
+    cfg.train.batch_size = 1
+    cfg.train.optimizer = optimizer
+    cfg.train.learning_rate = 2e-4
+    cfg.train.dtype = "bfloat16"
+    cfg.train.log_every = 0
+    cfg.train.parallel_strategy = "ddp"
+    cfg.train.offload_opt_state = offload
+
+    rt = initialize_runtime(cfg)
+    model = build_model("transformer_1b", dtype="bfloat16",
+                        remat=True, remat_policy="full")
+    ds = SyntheticLMDataset(size=8, seq_len=seq_len, vocab_size=50304,
+                            seed=0)
+    loader = ShardedDataLoader(ds, rt, batch_size=1, shuffle=False)
+    trainer = Trainer(cfg, rt, model, loader)
+    batch = next(iter(loader.epoch(0)))
+
+    t0 = time.perf_counter()
+    for _ in range(WARMUP):
+        metrics = trainer.train_step(batch)
+    jax.block_until_ready(metrics["loss"])
+    compile_s = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    for _ in range(STEPS):
+        metrics = trainer.train_step(batch)
+    jax.block_until_ready(metrics["loss"])
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = STEPS * loader.global_batch * seq_len / dt
+    mfu = (tokens_per_sec * model.flops_per_token(seq_len)
+           / rt.num_devices / peak_flops_per_chip(rt.device_kind))
+    return {
+        "metric": "transformer_1b_train_single_chip",
+        "tokens_per_sec_per_chip": round(
+            tokens_per_sec / rt.num_devices, 1),
+        "mfu": round(float(mfu), 4),
+        "step_time_ms": round(1000 * dt / STEPS, 1),
+        "seq_len": seq_len,
+        "batch": 1,
+        "optimizer": optimizer,
+        "offload_opt_state": offload,
+        "remat_policy": "full",
+        "compile_plus_warmup_s": round(compile_s, 1),
+        "device_kind": rt.device_kind,
+        "loss": round(float(metrics["loss"]), 4),
+    }
+
+
+def main() -> int:
+    errors = []
+    for att in ATTEMPTS:
+        try:
+            rec = run(**{k: v for k, v in att.items()
+                         if k != "offload"},
+                      offload=att["offload"])
+            rec["fallbacks"] = errors
+            print(json.dumps(rec), flush=True)
+            return 0
+        except Exception as e:  # noqa: BLE001 — fall through the ladder
+            errors.append({"attempt": att,
+                           "error": f"{type(e).__name__}: {e}"[:300]})
+            if not _is_oom(e):
+                break
+    print(json.dumps({"metric": "transformer_1b_train_single_chip",
+                      "error": errors}), flush=True)
+    return 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

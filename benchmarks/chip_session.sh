@@ -1,0 +1,49 @@
+#!/bin/bash
+# One healthy-chip window → every round-4 measurement, sequentially
+# (never two TPU processes at once). Run when chip_status says ALIVE,
+# with probe_loop.sh STOPPED first. All evidence lands under
+# benchmarks/state/session_<UTC>/ as JSON + logs.
+#
+#   pkill -f probe_loop.sh; bash benchmarks/chip_session.sh
+set -u
+cd /root/repo
+export PYTHONPATH=/root/repo:/root/.axon_site
+OUT=benchmarks/state/session_$(date -u +%H%M%S)
+mkdir -p "$OUT"
+echo "chip session -> $OUT"
+
+phase() {  # phase NAME TIMEOUT_S CMD...
+  local name=$1 t=$2; shift 2
+  echo "[session] phase=$name start=$(date -u +%H:%M:%S)" | tee -a "$OUT/session.log"
+  timeout -k 30 "$t" "$@" >"$OUT/$name.out" 2>"$OUT/$name.log"
+  local rc=$?
+  echo "[session] phase=$name rc=$rc end=$(date -u +%H:%M:%S)" | tee -a "$OUT/session.log"
+  return $rc
+}
+
+# 1. Headline (batch32+mlp-remat vs no-remat unroll contender).
+phase headline 2400 python bench.py
+
+# 2. Full tuning matrix (cheap->expensive; survives OOM points).
+phase tune 3600 python benchmarks/tune_headline.py
+
+# 3. Traces: batch-8 (the unexplained 2x fwd gap) and the headline
+#    batch. analyze_trace runs on CPU afterwards, no chip needed.
+phase trace8 1200 python benchmarks/profile_step.py --batch 8 \
+  --trace "$OUT/trace_b8"
+phase trace32 1200 python benchmarks/profile_step.py --batch 32 \
+  --model-kwargs '{"remat": true, "remat_policy": "mlp"}' \
+  --trace "$OUT/trace_b32"
+
+# 4. 1B single-chip measured run (plan: benchmarks/plan_memory.py).
+phase bench1b 2400 python benchmarks/bench_1b_single_chip.py
+
+# 5. CPU-side trace analysis (forced off-chip).
+for t in trace_b8 trace_b32; do
+  if [ -d "$OUT/$t" ]; then
+    JAX_PLATFORMS=cpu timeout 600 python benchmarks/analyze_trace.py \
+      "$OUT/$t" --json >"$OUT/analyze_$t.json" 2>>"$OUT/session.log"
+  fi
+done
+
+echo "[session] done $(date -u +%H:%M:%S)" | tee -a "$OUT/session.log"

@@ -1,0 +1,93 @@
+#!/usr/bin/env python
+"""Estimator-validated memory plans for the BASELINE large configs.
+
+Emits one JSON line per plan: the 1B single-chip measurement config
+(what bench_1b_single_chip.py runs when a healthy chip window opens)
+and the 1B/7B production layouts on the BASELINE target hardware
+(v4-32: 32 GiB HBM/chip). Planning numbers from
+utils/memory.estimate_transformer_memory — the same calibrated model
+the auto-batch bench resolver uses — not allocator ground truth.
+
+    python benchmarks/plan_memory.py            # all plans, one JSON/line
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+
+# (name, preset, chip, overrides, layout)
+PLANS = [
+    # The single-chip 1B measurement: full 24-layer model with
+    # adafactor (factored second moment ~2% of params — AdamW's
+    # 10.5 GiB of fp32 moments cannot share a 16 GiB chip with
+    # 5.3 GiB params + 5.3 GiB grads, and the current opt-state
+    # offload still visits the device at peak), full remat.
+    ("1b_single_chip_v5e", "transformer_1b", "v5e",
+     dict(remat=True, remat_policy="full"),
+     dict(batch_per_chip=1, seq_len=1024, fsdp=1, tp=1,
+          optimizer="adafactor")),
+    # 1B production on v4-32: fsdp=8 keeps everything resident.
+    ("1b_fsdp8_v4", "transformer_1b", "v4",
+     dict(remat=True, remat_policy="mlp"),
+     dict(batch_per_chip=8, seq_len=2048, fsdp=8, tp=1)),
+    # 7B production on v4-32 (BASELINE config 5: FSDP + gradient
+    # checkpointing + mixed precision).
+    ("7b_fsdp8_v4", "transformer_7b", "v4",
+     dict(),  # preset already carries remat=True (selective)
+     dict(batch_per_chip=4, seq_len=2048, fsdp=8, tp=1)),
+    ("7b_fsdp16_v4", "transformer_7b", "v4",
+     dict(),
+     dict(batch_per_chip=4, seq_len=2048, fsdp=16, tp=1)),
+    # 7B long-context variant: full remat + fsdp x tp.
+    ("7b_fsdp8_tp4_v4", "transformer_7b", "v4",
+     dict(remat_policy="full"),
+     dict(batch_per_chip=2, seq_len=8192, fsdp=8, tp=4)),
+]
+
+
+def plan(name: str, preset: str, chip: str, overrides: dict,
+         layout: dict) -> dict:
+    from distributed_training_tpu.models.transformer import (
+        PRESETS, TransformerConfig)
+    from distributed_training_tpu.utils.memory import (
+        HBM_GIB, estimate_transformer_memory)
+
+    cfg = TransformerConfig(dtype="bfloat16",
+                            **{**PRESETS[preset], **overrides})
+    est = estimate_transformer_memory(cfg, **layout)
+    return {
+        "plan": name,
+        "preset": preset,
+        "chip": chip,
+        "hbm_gib": HBM_GIB[chip],
+        "overrides": overrides,
+        "layout": layout,
+        "params_gib": round(est.params_gib, 2),
+        "grads_gib": round(est.grads_gib, 2),
+        "opt_gib": round(est.opt_gib, 2),
+        "activations_gib": round(est.activations_gib, 2),
+        "total_gib": round(est.total_gib, 2),
+        "fits": est.fits(chip),
+    }
+
+
+def main() -> int:
+    # Pure planning — no device needed; pin CPU so a sick accelerator
+    # runtime can't hang abstract shape evaluation.
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    ok = True
+    for args in PLANS:
+        rec = plan(*args)
+        print(json.dumps(rec))
+        ok = ok and rec["fits"]
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -248,9 +248,17 @@ class Transformer:
         # A window covering the whole (or more of the) sequence is
         # mathematically plain causal; normalize to 0 so the dispatch
         # keeps the fused/flash paths (windowed ring blocks run the
-        # einsum reference) and skips no-op band masks.
+        # einsum reference) and skips no-op band masks. The comparison
+        # is against the GLOBAL sequence length: inside the pipeline's
+        # shard_map with sequence parallelism, q.shape[1] is the local
+        # S/sp shard — comparing the window against THAT would turn a
+        # valid window silently into full causal.
+        S_total = q.shape[1]
+        if self._inside_pp and c.attention_impl in ("ring", "ulysses"):
+            from distributed_training_tpu.runtime import AXIS_SP
+            S_total *= self._mesh_axis_sizes().get(AXIS_SP, 1)
         window = (c.attention_window
-                  if 0 < c.attention_window < q.shape[1] else 0)
+                  if 0 < c.attention_window < S_total else 0)
         if c.attention_impl in ("ring", "ulysses"):
             if self.mesh is None:
                 raise ValueError(

@@ -108,6 +108,19 @@ def _block_attn_naive(q, k, v, mode: str, offset=None, window: int = 0):
     return out, lse
 
 
+def _validate_tile_overrides(q, k, block_q: int, block_k: int) -> None:
+    """Raise-don't-ignore: an explicit flash tile override that does
+    not divide the local shard would otherwise be silently dropped —
+    how sweeps misattribute their own measurements."""
+    S, Sk = q.shape[1], k.shape[1]
+    if (block_q and S % min(block_q, S)) or (
+        block_k and Sk % min(block_k, Sk)
+    ):
+        raise ValueError(
+            f"flash tile overrides ({block_q}, {block_k}) do not "
+            f"divide the local shard lengths ({S}, {Sk})")
+
+
 def _flash_block_ok(q, k, block_impl: str, block_q: int = 0,
                     block_k: int = 0) -> bool:
     """Route this block through the Pallas flash kernel? Static
@@ -119,13 +132,8 @@ def _flash_block_ok(q, k, block_impl: str, block_q: int = 0,
     shard raise for the same reason — a silently ignored override is
     how sweeps misattribute their own measurements."""
     from distributed_training_tpu.ops import flash_attention as fa
+    _validate_tile_overrides(q, k, block_q, block_k)
     S, Sk = q.shape[1], k.shape[1]
-    if (block_q and S % min(block_q, S)) or (
-        block_k and Sk % min(block_k, Sk)
-    ):
-        raise ValueError(
-            f"flash tile overrides ({block_q}, {block_k}) do not "
-            f"divide the local shard lengths ({S}, {Sk})")
     if block_impl == "naive":
         return False
     if block_impl == "flash":
@@ -479,13 +487,7 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                 "block_impl='flash' is unsupported with window > 0 "
                 "(the per-block flash kernels don't model the offset "
                 "band mask); use block_impl='auto' or 'naive'")
-        S, Sk = q.shape[1], k.shape[1]
-        if (block_q and S % min(block_q, S)) or (
-            block_k and Sk % min(block_k, Sk)
-        ):
-            raise ValueError(
-                f"flash tile overrides ({block_q}, {block_k}) do not "
-                f"divide the local shard lengths ({S}, {Sk})")
+        _validate_tile_overrides(q, k, block_q, block_k)
     sp = jax.lax.axis_size(axis_name)
 
     if sp == 1:
@@ -493,13 +495,7 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         # naive block — the Pallas fwd kernel alone has no vjp outside
         # the ring's custom VJP). The raise-don't-ignore contract on
         # tile overrides still applies.
-        S, Sk = q.shape[1], k.shape[1]
-        if (block_q and S % min(block_q, S)) or (
-            block_k and Sk % min(block_k, Sk)
-        ):
-            raise ValueError(
-                f"flash tile overrides ({block_q}, {block_k}) do not "
-                f"divide the local shard lengths ({S}, {Sk})")
+        _validate_tile_overrides(q, k, block_q, block_k)
         out, _ = _block_attn_naive(q, k, v,
                                    "causal" if causal else "full",
                                    window=window)

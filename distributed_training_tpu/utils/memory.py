@@ -63,6 +63,7 @@ class MemoryEstimate:
 def estimate_transformer_memory(
         tf_cfg, batch_per_chip: int, seq_len: int,
         optimizer: str = "adamw", fsdp: int = 1, tp: int = 1,
+        offload_opt: bool = False,
 ) -> MemoryEstimate:
     """Per-chip training footprint of a ``TransformerConfig``.
 
@@ -105,6 +106,16 @@ def estimate_transformer_memory(
     model_shards = max(1, fsdp) * max(1, tp)
     params_b = n_params * pb / model_shards
     grads_b = n_params * pb / model_shards
+    # offload_opt (train.offload_opt_state) moves moments to pinned
+    # host RAM BETWEEN steps, but the current trainer streams the whole
+    # tree back on-device for the compiled step (trainer.py
+    # train_step), so the per-step peak this estimate feeds fits()
+    # still includes the full optimizer state. The flag therefore buys
+    # no planning headroom until the step itself consumes moments from
+    # host memory (XLA host-offload annotations — the documented
+    # upgrade path in train/state.py). Use optimizer="adafactor" when
+    # the plan needs genuinely small moments.
+    del offload_opt
     if optimizer == "adamw":
         opt_b = 2 * n_params * 4 / model_shards
     elif optimizer == "adafactor":

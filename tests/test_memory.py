@@ -1,6 +1,8 @@
 """HBM estimator: exact param accounting vs real models, sane
 activation scaling, and the fit/sharding arithmetic."""
 
+import os
+
 import jax
 import numpy as np
 import pytest
@@ -8,6 +10,8 @@ import pytest
 from distributed_training_tpu.models.transformer import (Transformer,
                                                          TransformerConfig)
 from distributed_training_tpu.utils import memory
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def cfg(**kw):
@@ -92,3 +96,46 @@ def test_7b_needs_sharding():
     sharded = memory.estimate_transformer_memory(c, 1, 2048, fsdp=32)
     assert sharded.params_gib + sharded.opt_gib < alone.params_gib + \
         alone.opt_gib
+
+
+def test_offload_does_not_hide_step_peak():
+    """offload_opt must NOT claim HBM savings: the current trainer
+    streams the whole moment tree back on-device for the compiled
+    step, so the per-step peak fits() models still includes it. The
+    path that genuinely shrinks moments is adafactor (factored second
+    moment) — the 1B single-chip plan (benchmarks/plan_memory.py)."""
+    from distributed_training_tpu.models.transformer import PRESETS
+    c = TransformerConfig(remat=True, remat_policy="full",
+                          **PRESETS["transformer_1b"])
+    resident = memory.estimate_transformer_memory(
+        c, 1, 1024, optimizer="adamw")
+    offloaded = memory.estimate_transformer_memory(
+        c, 1, 1024, optimizer="adamw", offload_opt=True)
+    assert resident.opt_gib > 8  # 2 fp32 moments of ~1.3B params
+    assert offloaded.opt_gib == resident.opt_gib
+    assert not offloaded.fits("v5e")
+    factored = memory.estimate_transformer_memory(
+        c, 1, 1024, optimizer="adafactor")
+    assert factored.opt_gib < 0.5
+    assert factored.fits("v5e")
+
+
+def test_plan_memory_all_plans_fit():
+    """Every committed BASELINE memory plan must keep fitting its
+    target chip — a regression guard on estimator recalibrations."""
+    import json
+    import subprocess
+    import sys
+
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "benchmarks",
+                                      "plan_memory.py")],
+        capture_output=True, text=True, timeout=180)
+    assert out.returncode == 0, out.stderr[-500:]
+    plans = [json.loads(line) for line in
+             out.stdout.strip().splitlines()]
+    assert len(plans) >= 5
+    assert all(p["fits"] for p in plans)
+    names = {p["plan"] for p in plans}
+    assert "1b_single_chip_v5e" in names  # what bench_1b runs
+    assert "7b_fsdp8_v4" in names        # BASELINE config 5 layout

@@ -218,3 +218,46 @@ def test_seqparallel_pp_composition_matches_dp(impl, schedule,
                        for b in loader.epoch(0)]
     np.testing.assert_allclose(losses["dp"], losses["pp_sp"],
                                rtol=1e-5, atol=1e-6)
+
+
+def test_windowed_ring_under_pipeline_matches_dp():
+    """pp=2 x sp=2 with a sliding window that EXCEEDS the local S/sp
+    shard but not the global sequence (window=10 > S_local=8): the
+    normalization must compare against the GLOBAL length, or this
+    silently degrades to full causal — pinned by matching the plain-dp
+    windowed loss (which differs measurably from full causal)."""
+    from distributed_training_tpu.config import Config
+    from distributed_training_tpu.data import (ShardedDataLoader,
+                                               SyntheticLMDataset)
+    from distributed_training_tpu.models.transformer import (
+        Transformer, TransformerConfig)
+    from distributed_training_tpu.train.trainer import Trainer
+
+    losses = {}
+    for tag, ndev, axes, attn, window in (
+            ("dp_full", 2, {}, "naive", 0),
+            ("dp_win", 2, {}, "naive", 10),
+            ("pp_sp_win", 8, {"pp": 2, "sp": 2}, "ring", 10)):
+        rt = fake_cpu_runtime(ndev, **axes)
+        cfg = Config()
+        cfg.train.batch_size = 2
+        cfg.train.total_epochs = 1
+        cfg.train.log_every = 0
+        cfg.train.learning_rate = 0.01
+        model = Transformer(TransformerConfig(
+            vocab_size=64, d_model=32, n_layers=4, n_heads=4,
+            max_seq_len=16, dtype="float32", attention_impl=attn,
+            attention_window=window, pos_encoding="rope",
+            pp_microbatches=2))
+        ds = SyntheticLMDataset(size=8, seq_len=16, vocab_size=64,
+                                seed=0)
+        loader = ShardedDataLoader(ds, rt, batch_size=2, shuffle=False)
+        trainer = Trainer(cfg, rt, model, loader)
+        losses[tag] = [float(trainer.train_step(b)["loss"])
+                       for b in loader.epoch(0)]
+    # The window changes the trajectory vs full causal...
+    assert any(abs(a - b) > 1e-6 for a, b in
+               zip(losses["dp_full"], losses["dp_win"]))
+    # ...and the pp x sp windowed ring reproduces the windowed dp one.
+    np.testing.assert_allclose(losses["dp_win"], losses["pp_sp_win"],
+                               rtol=1e-5, atol=1e-6)
