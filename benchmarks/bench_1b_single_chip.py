@@ -33,8 +33,8 @@ ATTEMPTS = [
     dict(seq_len=512, optimizer="adafactor", offload=False),
     dict(seq_len=512, optimizer="sgd", offload=False),
 ]
-STEPS = int(os.environ.get("DTT_1B_STEPS", "5"))
-WARMUP = int(os.environ.get("DTT_1B_WARMUP", "2"))
+STEPS = max(1, int(os.environ.get("DTT_1B_STEPS", "5")))
+WARMUP = max(1, int(os.environ.get("DTT_1B_WARMUP", "2")))
 
 
 def run(seq_len: int, optimizer: str, offload: bool) -> dict:
@@ -102,9 +102,7 @@ def main() -> int:
     errors = []
     for att in ATTEMPTS:
         try:
-            rec = run(**{k: v for k, v in att.items()
-                         if k != "offload"},
-                      offload=att["offload"])
+            rec = run(**att)
             rec["fallbacks"] = errors
             print(json.dumps(rec), flush=True)
             return 0

@@ -8,7 +8,7 @@
 set -u
 cd /root/repo
 export PYTHONPATH=/root/repo:/root/.axon_site
-OUT=benchmarks/state/session_$(date -u +%H%M%S)
+OUT=benchmarks/state/session_$(date -u +%Y%m%d_%H%M%S)
 mkdir -p "$OUT"
 echo "chip session -> $OUT"
 

@@ -747,18 +747,30 @@ class Transformer:
 
     # -- generation --------------------------------------------------------
 
+    def _decode_cache_len(self, max_len: int) -> int:
+        """KV-cache sequence capacity for decode: a sliding window
+        needs only the last ``window`` positions (the rolling buffer —
+        O(window) decode memory instead of O(max_len)); full causal
+        keeps every position."""
+        c = self.cfg
+        if c.attention_window:
+            return min(max_len, c.attention_window)
+        return max_len
+
     def _attend_cache(self, q, k_cache, v_cache, pos):
         """Single-position attention: q (B, 1, H, hd) against the cache
-        (B, Sm, Hkv, hd), keys at positions <= pos (and within
-        ``attention_window`` of pos when set — decode honors the same
-        band the training mask applies). GQA-grouped like
-        ops.attention (hkv-major head order).
+        (B, Sm, Hkv, hd). GQA-grouped like ops.attention (hkv-major
+        head order).
 
-        The cache stays O(max_len) even under a window — masked slots
-        are computed then dropped. A rolling window-sized KV buffer
-        (dynamic_update_slice modulo window) is the decode-throughput
-        upgrade path if generation ever becomes a hot path; training
-        (the benchmarked path) is unaffected."""
+        The cache is a MODULAR ring over absolute positions: position
+        p lives in slot ``p % Sm``, so slot s currently holds absolute
+        position ``pos − ((pos − s) mod Sm)`` — for a full-length
+        cache (Sm > pos) that reduces to s itself for s ≤ pos and a
+        negative (masked) value beyond it, and for a window-sized
+        rolling buffer it is the newest ≤ pos occupant of the slot.
+        One mask therefore covers both layouts: visible iff the slot's
+        absolute position is ≥ 0 (ever written) and inside the
+        attention window when one is set."""
         c = self.cfg
         group = c.n_heads // c.n_kv_heads
         B, Sm = k_cache.shape[0], k_cache.shape[1]
@@ -767,10 +779,11 @@ class Transformer:
             "bhgd,bshd->bhgs", qg, k_cache,
             preferred_element_type=jnp.float32) * c.head_dim ** -0.5
         idx = jnp.arange(Sm)[None, None, None, :]
-        mask = idx <= pos
+        abs_pos = pos - ((pos - idx) % Sm)
+        mask = abs_pos >= 0
         if c.attention_window:
             mask = jnp.logical_and(
-                mask, idx >= pos - (c.attention_window - 1))
+                mask, abs_pos >= pos - (c.attention_window - 1))
         logits = jnp.where(mask, logits, -1e30)
         probs = jax.nn.softmax(logits, axis=-1)
         out = jnp.einsum("bhgs,bshd->bhgd",
@@ -789,10 +802,13 @@ class Transformer:
         v = jnp.einsum("bsd,dhk->bshk", h, layer["attn"]["wv"].astype(dt))
         if c.pos_encoding == "rope":
             q, k = _rope(q, k, jnp.full((1,), pos, jnp.int32))
+        # Modular slot: identity for a full-length cache, ring-wrap
+        # for the window-sized rolling buffer (see _attend_cache).
+        slot = pos % k_cache.shape[1]
         k_cache = jax.lax.dynamic_update_slice(
-            k_cache, k.astype(k_cache.dtype), (0, pos, 0, 0))
+            k_cache, k.astype(k_cache.dtype), (0, slot, 0, 0))
         v_cache = jax.lax.dynamic_update_slice(
-            v_cache, v.astype(v_cache.dtype), (0, pos, 0, 0))
+            v_cache, v.astype(v_cache.dtype), (0, slot, 0, 0))
         attn = self._attend_cache(q, k_cache, v_cache, pos)
         x = x + jnp.einsum("bshk,hkd->bsd", attn,
                            layer["attn"]["wo"].astype(dt))
@@ -838,10 +854,20 @@ class Transformer:
             return (x,), kv
 
         (x,), (ks, vs) = jax.lax.scan(body, (x,), stacked)
-        # ks: (L, B, P, Hkv, hd) → padded caches
-        pad = [(0, 0), (0, 0), (0, max_len - P), (0, 0), (0, 0)]
-        k_cache = jnp.pad(ks.astype(dt), pad)
-        v_cache = jnp.pad(vs.astype(dt), pad)
+        # ks: (L, B, P, Hkv, hd) → caches of capacity Sm. Windowed
+        # decode keeps only the last min(P, Sm) prompt positions, each
+        # in its modular slot p % Sm (slots hit at most once — the kept
+        # positions are consecutive), matching _attend_cache's ring
+        # layout; a full-length cache gets the identity layout (slot p
+        # == p) plus zero padding.
+        Sm = self._decode_cache_len(max_len)
+        keep = min(P, Sm)
+        zshape = (c.n_layers, B, Sm) + ks.shape[3:]
+        slots = (jnp.arange(P - keep, P) % Sm).astype(jnp.int32)
+        k_cache = jnp.zeros(zshape, dt).at[:, :, slots].set(
+            ks[:, :, P - keep:].astype(dt))
+        v_cache = jnp.zeros(zshape, dt).at[:, :, slots].set(
+            vs[:, :, P - keep:].astype(dt))
         return k_cache, v_cache, self._lm_head(params, x[:, -1])
 
     def generate(self, params, prompt, max_new_tokens: int,
