@@ -836,8 +836,12 @@ class Transformer:
 
     def prefill(self, params, tokens, max_len: int):
         """Run the prompt (B, P) through the stack, returning per-layer
-        KV caches padded to ``max_len`` plus fp32 logits for the next
-        position: (k_cache (L,B,max_len,Hkv,hd), v_cache, logits)."""
+        KV caches plus fp32 logits for the next position:
+        (k_cache (L,B,Sm,Hkv,hd), v_cache, logits), where
+        ``Sm = _decode_cache_len(max_len)`` — ``max_len`` for full
+        causal, the window size for windowed models (the rolling
+        ring-slot layout _attend_cache reads; position p lives in slot
+        ``p % Sm``)."""
         c = self.cfg
         dt = jnp.dtype(c.dtype)
         B, P = tokens.shape

@@ -176,11 +176,13 @@ def _flash_blocks(qt, block_q: int = 0, block_k: int = 0):
 
 
 def _block_attn_flash(qt, k, v, mode: str, block_q: int = 0,
-                      block_k: int = 0):
+                      block_k: int = 0, window: int = 0):
     """One ring block via the Pallas flash kernel (MXU-tiled, O(tile)
     scores memory). ``qt`` is the loop-invariant (B,H,S,D) transpose of
     the local queries — hoisted out of the ring scan by the caller
-    (k/v rotate, so their transposes legitimately live in the step)."""
+    (k/v rotate, so their transposes legitimately live in the step).
+    ``window``: legal only for the DIAGONAL block (offset 0 — the
+    aligned geometry the kernel's band support models)."""
     from distributed_training_tpu.ops import flash_attention as fa
     bq, bk = _flash_blocks(qt, block_q, block_k)
     # f32 out: per-block partials must not round to the input dtype
@@ -189,7 +191,7 @@ def _block_attn_flash(qt, k, v, mode: str, block_q: int = 0,
     out, lse = fa._flash_fwd(qt, _bhsd(k), _bhsd(v),
                              causal=(mode == "causal"),
                              block_q=bq, block_k=bk,
-                             out_dtype=jnp.float32)
+                             out_dtype=jnp.float32, window=window)
     return _bhsd(out), lse[..., 0]
 
 
@@ -237,19 +239,20 @@ def _ring_fwd_scan(q, k, v, axis_name: str, causal: bool,
     B, S, H, D = q.shape
     perm = _ring_perm(sp)
 
-    # Sliding-window blocks carry a positional offset mask the Pallas
-    # per-block kernels don't model yet; windowed rings run the einsum
-    # blocks (whole-block skipping still bounds the work by the window).
-    use_flash = (not window) and _flash_block_ok(q, k, block_impl,
-                                                 block_q, block_k)
+    # The Pallas kernel models the band only in the ALIGNED geometry
+    # (offset 0), so under a window it serves the diagonal block — the
+    # dominant computed block once out-of-window blocks are skipped —
+    # while offset (past/boundary) blocks run the einsum reference.
+    # Without a window every block is flash-eligible.
+    use_flash = _flash_block_ok(q, k, block_impl, block_q, block_k)
     # Loop-invariant: hoisted here because XLA's while-loop LICM does
     # not lift computations out of lax.switch branch computations.
     qt = _bhsd(q) if use_flash else None
 
     def block(kv, mode, offset):
-        if use_flash:
+        if use_flash and (not window or mode == "causal"):
             return _block_attn_flash(qt, kv[0], kv[1], mode,
-                                     block_q, block_k)
+                                     block_q, block_k, window=window)
         return _block_attn_naive(q, kv[0], kv[1], mode,
                                  offset=offset, window=window)
 
@@ -333,18 +336,21 @@ def _block_grads_naive(q, k, v, do_g, lse, delta, mode: str,
 
 
 def _block_grads_flash(qt, dot, k, v, lse, delta, mode: str,
-                       block_q: int = 0, block_k: int = 0):
+                       block_q: int = 0, block_k: int = 0,
+                       window: int = 0):
     """Per-block gradients via the Pallas flash backward kernels. Feeds
     the FINAL (lse, delta) — the FA2 trick makes per-block kernels
     compose into the ring total without any per-block statistics.
     ``qt``/``dot`` are the loop-invariant (B,H,S,D) transposes of the
-    local queries / upstream grads, hoisted out of the ring scan."""
+    local queries / upstream grads, hoisted out of the ring scan.
+    ``window``: diagonal block only (aligned geometry)."""
     from distributed_training_tpu.ops import flash_attention as fa
     bq, bk = _flash_blocks(qt, block_q, block_k)
     dq, dk, dv = fa._flash_bwd(
         qt, _bhsd(k), _bhsd(v), None, lse[..., None], dot,
         causal=(mode == "causal"), block_q=bq, block_k=bk,
-        delta=delta[..., None], grads_dtype=jnp.float32)
+        delta=delta[..., None], grads_dtype=jnp.float32,
+        window=window)
     return _bhsd(dq), _bhsd(dk), _bhsd(dv)
 
 
@@ -386,20 +392,25 @@ def _ring_core_bwd(axis_name, causal, block_impl, block_q, block_k,
     # Loop-invariant per-path precomputes, hoisted out of the scan
     # (XLA's while-loop LICM does not lift out of switch branches):
     # flash wants (B,H,S,D) q/dO; the einsum path wants grouped dO.
-    use_flash = (not window) and _flash_block_ok(q, k, block_impl,
-                                                 block_q, block_k)
+    use_flash = _flash_block_ok(q, k, block_impl, block_q, block_k)
     if use_flash:
-        qt, dot, do_g = _bhsd(q), _bhsd(do), None
+        qt, dot = _bhsd(q), _bhsd(do)
     else:
         qt = dot = None
+    if not use_flash or window:
+        # The einsum path serves every block when flash is off, and
+        # the offset (past/boundary) blocks under a window.
         do_g = do_f.reshape(B, S, Hkv, group, D).transpose(
             0, 2, 3, 1, 4
         )
+    else:
+        do_g = None
 
     def block_grads(kv, mode, offset):
-        if use_flash:
+        if use_flash and (not window or mode == "causal"):
             return _block_grads_flash(qt, dot, kv[0], kv[1], lse,
-                                      delta, mode, block_q, block_k)
+                                      delta, mode, block_q, block_k,
+                                      window=window)
         return _block_grads_naive(q, kv[0], kv[1], do_g, lse, delta,
                                   mode, offset=offset, window=window)
 
@@ -470,18 +481,22 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     positions — query i attends keys [i − window + 1, i] across shard
     boundaries. Ring blocks entirely behind the window are skipped
     (work per device is O(S_local · window), not O(S_local · S)); the
-    boundary block gets an offset band mask. Windowed blocks run the
-    einsum path (the per-block flash kernels don't model the offset
-    mask yet). Requires ``causal=True``.
+    diagonal block runs the flash kernel with its aligned band mask
+    when tile-friendly, while offset (past/boundary) blocks run the
+    einsum path (the kernels don't model the offset band). Requires
+    ``causal=True``.
     """
     if window and not causal:
         raise ValueError("window > 0 requires causal=True")
     if window < 0:
         raise ValueError(f"window must be >= 0, got {window}")
     if window:
-        # Windowed blocks run the einsum path; the raise-don't-ignore
-        # contract on explicit kernel config still holds — a silently
-        # demoted sweep misattributes its own measurements.
+        # Under a window only the DIAGONAL block can use the flash
+        # kernel (aligned band); offset blocks run the einsum path.
+        # Forcing 'flash' would therefore be partially ignored — the
+        # raise-don't-ignore contract on explicit kernel config makes
+        # that loud (a silently demoted sweep misattributes its own
+        # measurements).
         if block_impl == "flash":
             raise ValueError(
                 "block_impl='flash' is unsupported with window > 0 "
