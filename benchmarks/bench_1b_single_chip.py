@@ -37,7 +37,14 @@ STEPS = max(1, int(os.environ.get("DTT_1B_STEPS", "5")))
 WARMUP = max(1, int(os.environ.get("DTT_1B_WARMUP", "2")))
 
 
-def run(seq_len: int, optimizer: str, offload: bool) -> dict:
+def run(seq_len: int, optimizer: str, offload: bool,
+        model_name: str = "transformer_1b",
+        model_kwargs: dict | None = None,
+        vocab_size: int = 50304) -> dict:
+    """``model_name``/``model_kwargs``/``vocab_size`` exist so tests
+    can drive the EXACT measurement path (adafactor + full remat +
+    bf16 + Trainer) at toy scale on CPU; production callers use the
+    defaults."""
     import jax
 
     from distributed_training_tpu.config import Config
@@ -58,10 +65,11 @@ def run(seq_len: int, optimizer: str, offload: bool) -> dict:
     cfg.train.offload_opt_state = offload
 
     rt = initialize_runtime(cfg)
-    model = build_model("transformer_1b", dtype="bfloat16",
-                        remat=True, remat_policy="full")
-    ds = SyntheticLMDataset(size=8, seq_len=seq_len, vocab_size=50304,
-                            seed=0)
+    model = build_model(model_name, dtype="bfloat16",
+                        remat=True, remat_policy="full",
+                        **(model_kwargs or {}))
+    ds = SyntheticLMDataset(size=8, seq_len=seq_len,
+                            vocab_size=vocab_size, seed=0)
     loader = ShardedDataLoader(ds, rt, batch_size=1, shuffle=False)
     trainer = Trainer(cfg, rt, model, loader)
     batch = next(iter(loader.epoch(0)))

@@ -216,3 +216,25 @@ def test_is_oom_classification():
     assert not bench._is_oom(RuntimeError(
         "could not allocate a tracer: shape mismatch"))
     assert not bench._is_oom(TypeError("bad shapes"))
+
+
+def test_bench_1b_measurement_path_cpu(cpu8):
+    """The exact 1B single-chip measurement path (adafactor + full
+    remat + bf16 through the real Trainer) at toy scale — catches
+    config drift in the script before a scarce healthy-chip window
+    burns on it."""
+    import bench_1b_single_chip as b1
+
+    del cpu8  # fixture pins the 8-device CPU platform
+    rec = b1.run(seq_len=16, optimizer="adafactor", offload=False,
+                 model_name="transformer",
+                 model_kwargs=dict(vocab_size=64, d_model=32,
+                                   n_layers=2, n_heads=4,
+                                   max_seq_len=16,
+                                   attention_impl="naive"),
+                 vocab_size=64)
+    import math
+    assert rec["metric"] == "transformer_1b_train_single_chip"
+    assert rec["tokens_per_sec_per_chip"] > 0
+    assert rec["optimizer"] == "adafactor"
+    assert math.isfinite(rec["loss"])
