@@ -26,5 +26,7 @@ print('OK', d[0].platform)
   else
     echo "WEDGED $ts rc=$rc" > "$STATE"; echo "$ts WEDGED rc=$rc" >> "$LOG"
   fi
-  sleep 120
+  # Quiet time between probes: a SIGKILLed hung client is itself a
+  # wedge risk, so give the tunnel room to clear on its own.
+  sleep 480
 done

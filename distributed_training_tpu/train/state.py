@@ -77,9 +77,16 @@ def state_shardings(mesh: Mesh, specs: dict,
     2×params fp32, the bulk of big-model residency, lives in host RAM.
     In-jit streaming via memory-space annotations (tiles resident
     only) is the upgrade path once XLA's host-offload annotations are
-    reliable on the deployed runtime. Requires host-memory support
-    (``supports_memory_kind``); raises otherwise rather than silently
-    keeping state on device."""
+    reliable on the deployed runtime — attempted on jax 0.9.0 (r4):
+    any jit whose out_shardings mix memory kinds AND include a scalar
+    output (Adam's count) fails XLA SPMD's
+    "Side-effect HLO must have sharding" RET_CHECK
+    (spmd_partitioner.cc:5743) because the scalar's placement
+    custom-call carries no sharding; and in-traced ``device_put`` to
+    host does not pin output residency without out_shardings. Re-try
+    when the partitioner handles scalar placements. Requires
+    host-memory support (``supports_memory_kind``); raises otherwise
+    rather than silently keeping state on device."""
     shardings = jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
                              is_leaf=lambda x: isinstance(x, P))
     if offload_opt_state:
