@@ -23,6 +23,12 @@ print('OK', d[0].platform)
   rc=$?
   if [ $rc -eq 0 ] && grep -q "OK tpu" "$OUT"; then
     echo "ALIVE $ts" > "$STATE"; echo "$ts ALIVE" >> "$LOG"
+    # Recovery: harvest everything in this healthy window immediately
+    # (never two TPU processes — probing pauses while the sequential
+    # session runs).
+    echo "$ts HARVEST_START" >> "$LOG"
+    bash /root/repo/benchmarks/chip_session.sh >> "$LOG" 2>&1
+    echo "$(date -u +%H:%M:%S) HARVEST_DONE" >> "$LOG"
   else
     echo "WEDGED $ts rc=$rc" > "$STATE"; echo "$ts WEDGED rc=$rc" >> "$LOG"
   fi
