@@ -157,6 +157,8 @@ class Trainer:
         # (e.g. model=byte_lm with the default regression dataset)
         # otherwise dies as a bare KeyError inside the jitted step.
         need = set(getattr(model, "batch_keys", ()) or ())
+        model_vocab = getattr(getattr(model, "cfg", None),
+                              "vocab_size", None)
         for role, ldr in (("train", loader), ("eval", eval_loader)):
             ds = getattr(ldr, "dataset", None)
             if need and ds is not None and len(ds) > 0:
@@ -169,6 +171,20 @@ class Trainer:
                         "synthetic_lm / bytes_file / memmap_tokens; "
                         "regression: synthetic*; images: "
                         "synthetic_images)")
+                # Token-id range check: ids >= the model's vocab read
+                # out-of-range embedding rows (XLA clamps the gather)
+                # and poison the loss as NaN — a config mistake that
+                # must fail with its cause named (e.g. the dataset's
+                # default vocab 50257 against a small-vocab model).
+                ds_vocab = getattr(ds, "vocab_size", None)
+                if (model_vocab and ds_vocab
+                        and ds_vocab > model_vocab):
+                    raise ValueError(
+                        f"the {role} dataset draws token ids from a "
+                        f"vocab of {ds_vocab} but the model embeds "
+                        f"only {model_vocab} — set train."
+                        "dataset_kwargs.vocab_size to the model's "
+                        "vocab (or pick the matching model config)")
         tcfg = cfg.train
         if (tcfg.grad_accum_steps > 1
                 and loader.batch_size % tcfg.grad_accum_steps):

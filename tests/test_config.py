@@ -123,3 +123,56 @@ def test_int_field_rejects_fractional_float():
         isinstance(cfg.train.batch_size, int)
     with pytest.raises(ConfigError, match="batch_size"):
         config_from_dict({"train": {"batch_size": 2.5}})
+
+
+def test_longcontext_preset_composes_and_trains():
+    """model=longcontext_7b + train=longcontext: the first-class
+    long-context surface (windowed GQA ring at 32k). Composition is
+    checked at full scale; the train step runs at a shrunken geometry
+    on the sp mesh (same code path, CPU-sized)."""
+    cfg = load_config(CONF, overrides=["model=longcontext_7b",
+                                       "train=longcontext"])
+    assert cfg.model.name == "transformer_7b"
+    kw = cfg.model.kwargs
+    assert kw["attention_impl"] == "ring"
+    assert kw["attention_window"] == 4096
+    assert kw["max_seq_len"] == 32768
+    assert cfg.train.dataset_kwargs["seq_len"] == 32768
+    assert cfg.train.parallel_strategy == "fsdp"
+
+    # Shrunken end-to-end: same composition, toy geometry.
+    import numpy as np
+
+    from distributed_training_tpu.data import (ShardedDataLoader,
+                                               SyntheticLMDataset)
+    from distributed_training_tpu.models import build_model
+    from distributed_training_tpu.runtime import fake_cpu_runtime
+    from distributed_training_tpu.train.trainer import Trainer
+
+    cfg = load_config(CONF, overrides=[
+        "model=longcontext_7b", "train=longcontext",
+        "train.dtype=float32", "train.batch_size=2",
+        "train.log_every=0", "train.min_shard_elems=1",
+        "model.kwargs.max_seq_len=64",
+        "model.kwargs.attention_window=24",
+        "model.kwargs.d_model=64", "model.kwargs.n_layers=2",
+        "model.kwargs.n_heads=4", "model.kwargs.n_kv_heads=2",
+        "model.kwargs.vocab_size=128",
+        "train.dataset_kwargs.seq_len=64",
+        "train.dataset_kwargs.vocab_size=128",
+        "train.dataset_size=16",
+    ])
+    rt = fake_cpu_runtime(8, sp=2, fsdp=2)
+    model = build_model(cfg.model.name, dtype=cfg.train.dtype,
+                        **cfg.model.kwargs)
+    ds = SyntheticLMDataset(
+        size=cfg.train.dataset_size,
+        seq_len=cfg.train.dataset_kwargs["seq_len"],
+        vocab_size=cfg.train.dataset_kwargs["vocab_size"], seed=0)
+    loader = ShardedDataLoader(ds, rt,
+                               batch_size=cfg.train.batch_size,
+                               shuffle=False)
+    trainer = Trainer(cfg, rt, model, loader)
+    loss = float(trainer.train_step(
+        next(iter(loader.epoch(0))))["loss"])
+    assert np.isfinite(loss)

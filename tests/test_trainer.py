@@ -204,3 +204,23 @@ def test_metrics_jsonl_stream(cpu8, tmp_path):
     assert lines2[0] == {"run_start": True, "step": 0}
     assert len(lines2) == 2          # truncated, then one new entry
     assert lines2[1]["loss"] is None  # NaN mapped to null, valid JSON
+
+
+def test_vocab_mismatch_fails_preflight(cpu8):
+    """A dataset whose token ids exceed the model's vocab previously
+    trained to NaN (out-of-range embedding gathers clamp silently);
+    the trainer must name the config mistake before tracing."""
+    from distributed_training_tpu.data import SyntheticLMDataset
+    from distributed_training_tpu.models.transformer import (
+        Transformer, TransformerConfig)
+
+    cfg = Config()
+    cfg.train.batch_size = 1
+    model = Transformer(TransformerConfig(
+        vocab_size=64, d_model=32, n_layers=1, n_heads=2,
+        max_seq_len=16, dtype="float32", attention_impl="naive"))
+    ds = SyntheticLMDataset(size=8, seq_len=16, vocab_size=50257,
+                            seed=0)
+    loader = ShardedDataLoader(ds, cpu8, batch_size=1)
+    with pytest.raises(ValueError, match="vocab of 50257"):
+        Trainer(cfg, cpu8, model, loader)
