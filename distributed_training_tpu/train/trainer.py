@@ -161,7 +161,9 @@ class Trainer:
                               "vocab_size", None)
         for role, ldr in (("train", loader), ("eval", eval_loader)):
             ds = getattr(ldr, "dataset", None)
-            if need and ds is not None and len(ds) > 0:
+            if ds is None:
+                continue
+            if need and len(ds) > 0:
                 have = set(ds.batch(np.array([0])).keys())
                 if not need <= have:
                     raise ValueError(
@@ -171,20 +173,21 @@ class Trainer:
                         "synthetic_lm / bytes_file / memmap_tokens; "
                         "regression: synthetic*; images: "
                         "synthetic_images)")
-                # Token-id range check: ids >= the model's vocab read
-                # out-of-range embedding rows (XLA clamps the gather)
-                # and poison the loss as NaN — a config mistake that
-                # must fail with its cause named (e.g. the dataset's
-                # default vocab 50257 against a small-vocab model).
-                ds_vocab = getattr(ds, "vocab_size", None)
-                if (model_vocab and ds_vocab
-                        and ds_vocab > model_vocab):
-                    raise ValueError(
-                        f"the {role} dataset draws token ids from a "
-                        f"vocab of {ds_vocab} but the model embeds "
-                        f"only {model_vocab} — set train."
-                        "dataset_kwargs.vocab_size to the model's "
-                        "vocab (or pick the matching model config)")
+            # Token-id range check (independent of batch_keys — any
+            # model exposing cfg.vocab_size gets it): ids >= the
+            # model's vocab read out-of-range embedding rows (XLA
+            # clamps the gather) and poison the loss as NaN — a
+            # config mistake that must fail with its cause named
+            # (e.g. the dataset's default vocab 50257 against a
+            # small-vocab model).
+            ds_vocab = getattr(ds, "vocab_size", None)
+            if model_vocab and ds_vocab and ds_vocab > model_vocab:
+                raise ValueError(
+                    f"the {role} dataset draws token ids from a "
+                    f"vocab of {ds_vocab} but the model embeds "
+                    f"only {model_vocab} — set train."
+                    "dataset_kwargs.vocab_size to the model's "
+                    "vocab (or pick the matching model config)")
         tcfg = cfg.train
         if (tcfg.grad_accum_steps > 1
                 and loader.batch_size % tcfg.grad_accum_steps):

@@ -238,3 +238,37 @@ def test_bench_1b_measurement_path_cpu(cpu8):
     assert rec["tokens_per_sec_per_chip"] > 0
     assert rec["optimizer"] == "adafactor"
     assert math.isfinite(rec["loss"])
+
+
+def test_tune_headline_matrix_plumbing(monkeypatch, capsys):
+    """tune_headline's matrix loop has never run on target hardware
+    (the r3 chip window never came) — validate the plumbing off-chip:
+    every point flows through run_sweep_point with its kwargs intact
+    and emits one parseable JSON line; an error point yields an error
+    row with EFFECTIVE merged kwargs and the matrix continues."""
+    import bench
+    import tune_headline
+
+    seen = []
+
+    def fake_measure(batch, seq_len=1024, timed_steps=10,
+                     warmup_steps=2, phase=None, **kw):
+        seen.append((batch, dict(kw)))
+        if kw.get("scan_unroll") == 12 and not kw.get("remat", True):
+            raise RuntimeError("RESOURCE_EXHAUSTED: fake OOM")
+        return {"mfu": 0.3, "batch": batch, "loss_finite": True,
+                "model_kwargs": kw}
+
+    monkeypatch.setattr(bench, "measure", fake_measure)
+    monkeypatch.setattr(sys, "argv", ["tune_headline.py", "--quick"])
+    tune_headline.main()
+    lines = capsys.readouterr().out.strip().splitlines()
+    rows = [json.loads(ln) for ln in lines]
+    assert len(rows) == len(tune_headline.QUICK)
+    assert len(seen) == len(tune_headline.QUICK)
+    errors = [r for r in rows if "error" in r]
+    # The no-remat full-unroll point fake-OOMs; its error row carries
+    # the merged kwargs so sweep analysis sees what actually ran.
+    assert len(errors) == 1
+    assert errors[0]["model_kwargs"]["scan_unroll"] == 12
+    assert all("point_wall_s" in r for r in rows)
