@@ -50,6 +50,27 @@ def op_rows(xplane_path: str) -> list[dict]:
     return rows
 
 
+def op_category(row: dict) -> str:
+    """Subsystem label for one op row. Prefers the tool's own Category
+    column; the op-name patterns are the fallback classifier."""
+    cat = row.get("Category")
+    if cat:
+        return str(cat)
+    name = str(row.get("Operation Name") or row.get("Operation")
+               or "").lower()
+    for pat, label in (("dot", "matmul"), ("conv", "conv"),
+                       ("fusion", "fusion"), ("copy", "copy"),
+                       ("transpose", "transpose"),
+                       ("gather", "gather"), ("scatter", "scatter"),
+                       ("all-reduce", "collective"),
+                       ("all-gather", "collective"),
+                       ("collective", "collective"),
+                       ("custom-call", "custom-call")):
+        if pat in name:
+            return label
+    return "other"
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("trace_dir")
@@ -91,6 +112,18 @@ def main() -> int:
         print(f"{t / 1e3:10.3f} {100 * t / max(total, 1e-9):6.2f}  "
               f"{str(name)[:90]}")
     print(f"{total / 1e3:10.3f} {100.0:6.2f}  TOTAL ({side} self time)")
+
+    # Category rollup — the view that attributes a step-time gap to a
+    # subsystem (MXU matmul vs data formatting vs memory traffic) in
+    # one glance.
+    agg: dict[str, float] = {}
+    for r in dev:
+        agg[op_category(r)] = (agg.get(op_category(r), 0.0)
+                               + float(r.get(key) or 0))
+    print(f"\n{'self ms':>10} {'%':>6}  category")
+    for cat, t in sorted(agg.items(), key=lambda kv: -kv[1]):
+        print(f"{t / 1e3:10.3f} {100 * t / max(total, 1e-9):6.2f}  "
+              f"{cat}")
     return 0
 
 

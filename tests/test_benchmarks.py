@@ -272,3 +272,19 @@ def test_tune_headline_matrix_plumbing(monkeypatch, capsys):
     assert len(errors) == 1
     assert errors[0]["model_kwargs"]["scan_unroll"] == 12
     assert all("point_wall_s" in r for r in rows)
+
+
+def test_analyze_trace_category_classifier():
+    """Category rollup labels: the tool's own Category column wins;
+    name patterns are the fallback; unknown ops land in 'other'."""
+    import analyze_trace as at
+
+    assert at.op_category({"Category": "Fusion"}) == "Fusion"
+    assert at.op_category(
+        {"Operation Name": "dot_general.42"}) == "matmul"
+    assert at.op_category(
+        {"Operation Name": "all-reduce.3"}) == "collective"
+    assert at.op_category({"Operation Name": "copy.7"}) == "copy"
+    assert at.op_category(
+        {"Operation Name": "mysterious.1"}) == "other"
+    assert at.op_category({}) == "other"
