@@ -52,19 +52,27 @@ def op_rows(xplane_path: str) -> list[dict]:
 
 def op_category(row: dict) -> str:
     """Subsystem label for one op row. Prefers the tool's own Category
-    column; the op-name patterns are the fallback classifier."""
+    column (lowercased so it can't split one subsystem across two
+    rollup lines against fallback labels); the op-name patterns are
+    the fallback classifier. Collective patterns come FIRST — they
+    embed 'gather'/'scatter' as substrings, and communication being
+    misfiled under memory ops would invert the matmul-vs-comms
+    conclusion this rollup exists to draw."""
     cat = row.get("Category")
     if cat:
-        return str(cat)
+        return str(cat).lower()
     name = str(row.get("Operation Name") or row.get("Operation")
                or "").lower()
-    for pat, label in (("dot", "matmul"), ("conv", "conv"),
+    for pat, label in (("all-to-all", "collective"),
+                       ("all-reduce", "collective"),
+                       ("all-gather", "collective"),
+                       ("reduce-scatter", "collective"),
+                       ("collective", "collective"),
+                       ("permute", "collective"),
+                       ("dot", "matmul"), ("conv", "conv"),
                        ("fusion", "fusion"), ("copy", "copy"),
                        ("transpose", "transpose"),
                        ("gather", "gather"), ("scatter", "scatter"),
-                       ("all-reduce", "collective"),
-                       ("all-gather", "collective"),
-                       ("collective", "collective"),
                        ("custom-call", "custom-call")):
         if pat in name:
             return label
@@ -118,8 +126,8 @@ def main() -> int:
     # one glance.
     agg: dict[str, float] = {}
     for r in dev:
-        agg[op_category(r)] = (agg.get(op_category(r), 0.0)
-                               + float(r.get(key) or 0))
+        c = op_category(r)
+        agg[c] = agg.get(c, 0.0) + float(r.get(key) or 0)
     print(f"\n{'self ms':>10} {'%':>6}  category")
     for cat, t in sorted(agg.items(), key=lambda kv: -kv[1]):
         print(f"{t / 1e3:10.3f} {100 * t / max(total, 1e-9):6.2f}  "

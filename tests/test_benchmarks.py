@@ -279,11 +279,22 @@ def test_analyze_trace_category_classifier():
     name patterns are the fallback; unknown ops land in 'other'."""
     import analyze_trace as at
 
-    assert at.op_category({"Category": "Fusion"}) == "Fusion"
+    assert at.op_category({"Category": "Fusion"}) == "fusion"
     assert at.op_category(
         {"Operation Name": "dot_general.42"}) == "matmul"
+    # Collectives win over their gather/scatter substrings — the
+    # misattribution that would invert a matmul-vs-comms conclusion.
     assert at.op_category(
         {"Operation Name": "all-reduce.3"}) == "collective"
+    assert at.op_category(
+        {"Operation Name": "all-gather.5"}) == "collective"
+    assert at.op_category(
+        {"Operation Name": "reduce-scatter.1"}) == "collective"
+    assert at.op_category(
+        {"Operation Name": "all-to-all.2"}) == "collective"
+    assert at.op_category(
+        {"Operation Name": "collective-permute.9"}) == "collective"
+    assert at.op_category({"Operation Name": "gather.4"}) == "gather"
     assert at.op_category({"Operation Name": "copy.7"}) == "copy"
     assert at.op_category(
         {"Operation Name": "mysterious.1"}) == "other"

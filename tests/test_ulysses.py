@@ -261,3 +261,40 @@ def test_windowed_ring_under_pipeline_matches_dp():
     # ...and the pp x sp windowed ring reproduces the windowed dp one.
     np.testing.assert_allclose(losses["dp_win"], losses["pp_sp_win"],
                                rtol=1e-5, atol=1e-6)
+
+
+def test_windowed_ring_interleaved_pipeline_matches_dp():
+    """Window + ring + INTERLEAVED virtual stages (the deepest
+    schedule composition): must reproduce the plain-dp windowed loss
+    like the GPipe variant above."""
+    from distributed_training_tpu.config import Config
+    from distributed_training_tpu.data import (ShardedDataLoader,
+                                               SyntheticLMDataset)
+    from distributed_training_tpu.models.transformer import (
+        Transformer, TransformerConfig)
+    from distributed_training_tpu.train.trainer import Trainer
+
+    losses = {}
+    for tag, ndev, axes, attn in (
+            ("dp_win", 2, {}, "naive"),
+            ("pp_sp_win", 8, {"pp": 2, "sp": 2}, "ring")):
+        rt = fake_cpu_runtime(ndev, **axes)
+        cfg = Config()
+        cfg.train.batch_size = 2
+        cfg.train.total_epochs = 1
+        cfg.train.log_every = 0
+        cfg.train.learning_rate = 0.01
+        model = Transformer(TransformerConfig(
+            vocab_size=64, d_model=32, n_layers=4, n_heads=4,
+            max_seq_len=16, dtype="float32", attention_impl=attn,
+            attention_window=10, pos_encoding="rope",
+            pp_microbatches=2, pp_schedule="interleaved",
+            pp_virtual_stages=2))
+        ds = SyntheticLMDataset(size=8, seq_len=16, vocab_size=64,
+                                seed=0)
+        loader = ShardedDataLoader(ds, rt, batch_size=2, shuffle=False)
+        trainer = Trainer(cfg, rt, model, loader)
+        losses[tag] = [float(trainer.train_step(b)["loss"])
+                       for b in loader.epoch(0)]
+    np.testing.assert_allclose(losses["dp_win"], losses["pp_sp_win"],
+                               rtol=1e-5, atol=1e-6)
