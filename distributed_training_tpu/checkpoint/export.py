@@ -20,15 +20,14 @@ import os
 import sys
 
 
-def export(ckpt_dir: str, out_path: str, step: int | None = None) -> dict:
+def restore_step_local(ckpt_dir: str, step: int | None = None
+                       ) -> tuple[dict, int]:
+    """Restore one checkpoint step's full state onto the LOCAL default
+    device via the checkpoint's own tree metadata — NOT the saved
+    shardings, so a pod checkpoint opens on any topology (usually a
+    single host). Returns (state, step); ``step=None`` → newest.
+    Shared by the export CLI and the generation CLI."""
     import jax
-
-    # Site customizations may pin the platform at interpreter start,
-    # overriding the env var — re-apply it so JAX_PLATFORMS=cpu really
-    # does keep this host-side tool off the accelerator.
-    if os.environ.get("JAX_PLATFORMS"):
-        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
-
     import orbax.checkpoint as ocp
     from jax.sharding import SingleDeviceSharding
 
@@ -42,12 +41,10 @@ def export(ckpt_dir: str, out_path: str, step: int | None = None) -> dict:
         step = steps[-1]
     state_path = os.path.join(ckpt_dir, str(step), "state")
     if not os.path.isdir(state_path):
-        raise FileNotFoundError(f"{state_path} does not exist")
+        raise FileNotFoundError(
+            f"checkpoint step {step} not found in {ckpt_dir} "
+            f"({state_path} does not exist)")
 
-    # Restore every leaf onto the local default device via the
-    # checkpoint's own tree metadata — NOT the saved shardings: the
-    # whole point of this tool is consolidating a pod checkpoint on a
-    # machine with a different (usually single-device) topology.
     dev = jax.devices()[0]
     ckptr = ocp.PyTreeCheckpointer()
     tree = ckptr.metadata(state_path).item_metadata.tree
@@ -57,6 +54,20 @@ def export(ckpt_dir: str, out_path: str, step: int | None = None) -> dict:
     state = ckptr.restore(
         state_path,
         args=ocp.args.PyTreeRestore(restore_args=restore_args))
+    return state, int(step)
+
+
+def export(ckpt_dir: str, out_path: str, step: int | None = None) -> dict:
+    import jax
+
+    # Site customizations may pin the platform at interpreter start,
+    # overriding the env var — re-apply it so JAX_PLATFORMS=cpu really
+    # does keep this host-side tool off the accelerator.
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+    ckpt_dir = os.path.abspath(ckpt_dir)
+    state, step = restore_step_local(ckpt_dir, step)
 
     meta: dict = {}
     meta_file = os.path.join(ckpt_dir, str(step), "meta", "metadata")

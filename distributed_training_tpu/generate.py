@@ -1,0 +1,190 @@
+"""Generation CLI: sample from a trained checkpoint.
+
+No counterpart exists in the reference (its models are Linear
+regressors, src/distributed_trainer.py:199); this closes the loop the
+transformer families open — train with the trainer CLI, then:
+
+    # Byte-level models (vocab 256): the prompt is literal UTF-8 —
+    # no tokenizer download, nothing to install.
+    python -m distributed_training_tpu.generate \
+        --run-dir outputs/default --prompt "def main(" \
+        --max-new-tokens 128 --temperature 0.8 --top-k 40
+
+    # Token models: ids in, ids out.
+    python -m distributed_training_tpu.generate \
+        --run-dir outputs/gpt2 --prompt-ids 50256,318 -n 32
+
+The model is rebuilt from the run's own ``resolved_config.yaml`` (the
+exact architecture that trained) and params come from the newest step
+under the run's checkpoint dir — or pass ``--artifact`` for a
+consolidated single-file export (checkpoint/export.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def _load_run_config(run_dir: str):
+    from distributed_training_tpu.config import config_from_dict
+
+    import yaml
+
+    path = os.path.join(run_dir, "resolved_config.yaml")
+    if not os.path.exists(path):
+        raise FileNotFoundError(
+            f"{path} not found — point --run-dir at a training run "
+            "directory (<run.output_dir>/<run.experiment_name>)")
+    with open(path) as f:
+        return config_from_dict(yaml.safe_load(f))
+
+
+def _restore_params(run_dir: str, snapshot_path: str,
+                    step: int | None):
+    """Newest (or given) step's params onto the local default device
+    (checkpoint/export.py::restore_step_local). ``snapshot_path`` was
+    anchored absolute on the TRAINING machine; when a copied run dir
+    no longer has it, fall back to the checkpoint dir inside
+    ``run_dir`` itself (the host-side-sampling use case)."""
+    from distributed_training_tpu.checkpoint.export import (
+        restore_step_local,
+    )
+
+    ckpt_dir = snapshot_path
+    if not os.path.isdir(ckpt_dir):
+        local = os.path.join(run_dir,
+                             os.path.basename(snapshot_path.rstrip(
+                                 os.sep)) or "checkpoints")
+        if not os.path.isdir(local):
+            raise FileNotFoundError(
+                f"no checkpoint dir at {snapshot_path} (from the "
+                f"run's resolved config) nor at {local}")
+        ckpt_dir = local
+    state, step = restore_step_local(ckpt_dir, step)
+    return state["params"], step
+
+
+def build_argparser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="dtt-generate",
+        description="Sample from a trained checkpoint")
+    src = p.add_mutually_exclusive_group(required=True)
+    src.add_argument("--run-dir",
+                     help="training run dir (holds resolved_config."
+                          "yaml + checkpoints)")
+    src.add_argument("--artifact",
+                     help="consolidated single-file export "
+                          "(checkpoint/export.py); the artifact holds "
+                          "params only, so the architecture must be "
+                          "respecified via --model-name and "
+                          "--model-kwargs")
+    p.add_argument("--step", type=int, default=None,
+                   help="checkpoint step (default: newest)")
+    prompt = p.add_mutually_exclusive_group(required=True)
+    prompt.add_argument("--prompt",
+                        help="UTF-8 text prompt (byte-vocab models)")
+    prompt.add_argument("--prompt-ids",
+                        help="comma-separated token ids")
+    p.add_argument("-n", "--max-new-tokens", type=int, default=64)
+    p.add_argument("--temperature", type=float, default=0.0,
+                   help="0 = greedy")
+    p.add_argument("--top-k", type=int, default=0)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--model-name", default=None)
+    p.add_argument("--model-kwargs", default="{}",
+                   help="JSON dict (with --artifact)")
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_argparser().parse_args(argv)
+
+    import jax
+
+    # Site customizations may pin the platform at interpreter start,
+    # overriding the env var — re-apply it so JAX_PLATFORMS=cpu really
+    # does keep host-side sampling off a (possibly sick) accelerator
+    # (same contract as checkpoint/export.py).
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributed_training_tpu.models import build_model
+
+    if args.run_dir:
+        cfg = _load_run_config(args.run_dir)
+        model_kwargs = dict(cfg.model.kwargs)
+        model_dtype = model_kwargs.pop("dtype", cfg.train.dtype)
+        model = build_model(cfg.model.name, loss=cfg.train.loss,
+                            dtype=model_dtype, **model_kwargs)
+        params, step = _restore_params(args.run_dir,
+                                       cfg.train.snapshot_path,
+                                       args.step)
+    else:
+        if args.step is not None:
+            raise ValueError(
+                "--step selects a step inside a run dir; a "
+                "consolidated artifact holds exactly one step "
+                "(re-export with checkpoint/export.py --step N)")
+        from distributed_training_tpu.checkpoint.consolidate import (
+            load_consolidated,
+        )
+        state, meta = load_consolidated(args.artifact)
+        if not args.model_name:
+            raise ValueError(
+                "--artifact needs --model-name and --model-kwargs: "
+                "the artifact holds params only, not the "
+                "architecture")
+        model = build_model(args.model_name,
+                            **json.loads(args.model_kwargs))
+        params = jax.tree.map(jnp.asarray, state["params"])
+        step = meta.get("step", -1)
+
+    if not hasattr(model, "generate"):
+        raise ValueError(
+            f"model family '{type(model).__name__}' has no "
+            "autoregressive decode path — generation needs a "
+            "transformer-family checkpoint")
+    vocab = model.cfg.vocab_size
+    if args.prompt is not None:
+        if vocab != 256:
+            raise ValueError(
+                f"--prompt is UTF-8 bytes, which needs a byte-vocab "
+                f"(256) model; this one has vocab {vocab} — pass "
+                "--prompt-ids instead")
+        ids = np.frombuffer(args.prompt.encode("utf-8"),
+                            dtype=np.uint8).astype(np.int32)
+    else:
+        ids = np.asarray([int(t) for t in
+                          args.prompt_ids.split(",")], np.int32)
+        if ids.size and (ids.min() < 0 or ids.max() >= vocab):
+            raise ValueError(
+                f"prompt ids must be in [0, {vocab}), got "
+                f"[{ids.min()}, {ids.max()}]")
+    if ids.size == 0:
+        raise ValueError("empty prompt")
+
+    prompt = jnp.asarray(ids)[None, :]
+    rng = jax.random.PRNGKey(args.seed)
+    out = model.generate(params, prompt,
+                         max_new_tokens=args.max_new_tokens,
+                         temperature=args.temperature,
+                         top_k=args.top_k, rng=rng)
+    out_ids = np.asarray(out[0])
+    print(f"# step={step} prompt_tokens={ids.size} "
+          f"sampled={out_ids.size}", file=sys.stderr)
+    if vocab == 256:
+        print(bytes(out_ids.astype(np.uint8)).decode(
+            "utf-8", errors="replace"))
+    else:
+        print(",".join(str(int(t)) for t in out_ids))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
