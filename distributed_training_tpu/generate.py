@@ -77,10 +77,10 @@ def build_argparser() -> argparse.ArgumentParser:
                           "yaml + checkpoints)")
     src.add_argument("--artifact",
                      help="consolidated single-file export "
-                          "(checkpoint/export.py); the artifact holds "
-                          "params only, so the architecture must be "
-                          "respecified via --model-name and "
-                          "--model-kwargs")
+                          "(checkpoint/export.py); artifacts written "
+                          "by this framework carry the architecture "
+                          "in their meta — --model-name/--model-kwargs "
+                          "override or fill in for foreign artifacts")
     p.add_argument("--step", type=int, default=None,
                    help="checkpoint step (default: newest)")
     prompt = p.add_mutually_exclusive_group(required=True)
@@ -135,13 +135,19 @@ def main(argv: list[str] | None = None) -> int:
             load_consolidated,
         )
         state, meta = load_consolidated(args.artifact)
-        if not args.model_name:
+        name = args.model_name or meta.get("model_name")
+        if not name:
             raise ValueError(
-                "--artifact needs --model-name and --model-kwargs: "
-                "the artifact holds params only, not the "
-                "architecture")
-        model = build_model(args.model_name,
-                            **json.loads(args.model_kwargs))
+                "--artifact carries no architecture meta (foreign or "
+                "pre-r4 export) — pass --model-name and "
+                "--model-kwargs")
+        # Meta fills in, explicit CLI flags win per-key ("override or
+        # fill in") — regardless of which of the two flags was given.
+        kwargs = dict(meta.get("model_kwargs") or {})
+        kwargs.setdefault("dtype", meta.get("model_dtype", "float32"))
+        kwargs.setdefault("loss", meta.get("loss", "auto"))
+        kwargs.update(json.loads(args.model_kwargs))
+        model = build_model(name, **kwargs)
         params = jax.tree.map(jnp.asarray, state["params"])
         step = meta.get("step", -1)
 

@@ -429,7 +429,8 @@ class Trainer:
                 meta_epoch = epoch if not preempted else epoch - 1
                 self.checkpointer.save(
                     self.global_step, self.state,
-                    meta={"epoch": meta_epoch}, force=preempted)
+                    meta={"epoch": meta_epoch, **self._arch_meta()},
+                    force=preempted)
                 if self.strategy.gather_on_save:
                     # Same epoch label as the sharded checkpoint: an
                     # interrupted epoch must not read as complete in
@@ -445,6 +446,17 @@ class Trainer:
         summary["wall_time_s"] = time.perf_counter() - t0
         return summary
 
+    def _arch_meta(self) -> dict:
+        """Architecture identity stamped into every checkpoint/artifact
+        meta, so a consolidated export is self-describing — the
+        generation CLI can rebuild the exact model without the run's
+        resolved config."""
+        return {"model_name": self.cfg.model.name,
+                "model_kwargs": dict(self.cfg.model.kwargs),
+                "model_dtype": self.cfg.model.kwargs.get(
+                    "dtype", self.cfg.train.dtype),
+                "loss": self.cfg.train.loss}
+
     # -- consolidated export -----------------------------------------------
 
     def export_consolidated(self, epoch: int | None = None,
@@ -459,7 +471,7 @@ class Trainer:
             path = os.path.join(
                 self.cfg.train.snapshot_path,
                 f"consolidated_step{self.global_step}.msgpack")
-        meta = {"step": self.global_step}
+        meta = {"step": self.global_step, **self._arch_meta()}
         if epoch is not None:
             meta["epoch"] = epoch
         return consolidate.export_consolidated(

@@ -98,6 +98,13 @@ def test_generate_artifact_path_agrees_with_run_dir(byte_run, capsys,
     out_art = capsys.readouterr().out
     assert out_run == out_art
 
+    # Artifacts are self-describing: no --model-name needed — the
+    # architecture meta stamped at save time rebuilds the exact model.
+    rc = gen_cli.main(["--artifact", str(art), "--prompt", "xyz",
+                       "-n", "6"])
+    assert rc == 0
+    assert capsys.readouterr().out == out_run
+
     # --step is meaningless with a single-step artifact: loud error.
     with pytest.raises(ValueError, match="exactly one step"):
         gen_cli.main(["--artifact", str(art), "--step", "3",
