@@ -42,6 +42,18 @@ def _load_run_config(run_dir: str):
         return config_from_dict(yaml.safe_load(f))
 
 
+def _build_model_from_cfg(cfg):
+    """Rebuild the exact trained architecture from a run's resolved
+    config (shared by the generate and eval CLIs — the dtype-pop rule
+    must not drift between them)."""
+    from distributed_training_tpu.models import build_model
+
+    model_kwargs = dict(cfg.model.kwargs)
+    model_dtype = model_kwargs.pop("dtype", cfg.train.dtype)
+    return build_model(cfg.model.name, loss=cfg.train.loss,
+                       dtype=model_dtype, **model_kwargs)
+
+
 def _restore_params(run_dir: str, snapshot_path: str,
                     step: int | None):
     """Newest (or given) step's params onto the local default device
@@ -118,10 +130,7 @@ def main(argv: list[str] | None = None) -> int:
 
     if args.run_dir:
         cfg = _load_run_config(args.run_dir)
-        model_kwargs = dict(cfg.model.kwargs)
-        model_dtype = model_kwargs.pop("dtype", cfg.train.dtype)
-        model = build_model(cfg.model.name, loss=cfg.train.loss,
-                            dtype=model_dtype, **model_kwargs)
+        model = _build_model_from_cfg(cfg)
         params, step = _restore_params(args.run_dir,
                                        cfg.train.snapshot_path,
                                        args.step)

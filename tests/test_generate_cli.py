@@ -133,3 +133,41 @@ def test_generate_moved_run_dir_falls_back_to_local(byte_run, capsys,
                        "-n", "4"])
     assert rc == 0
     assert "sampled=4" in capsys.readouterr().err
+
+
+def test_eval_cli_scores_checkpoint(byte_run, capsys):
+    """Offline eval: the run's own dataset scores to a finite loss,
+    and the loss ties back to training (an untrained-vocab-256 model
+    sits near ln(256); the trained one must be at or below it)."""
+    import math
+
+    from distributed_training_tpu import eval as eval_cli
+
+    rc = eval_cli.main(["--run-dir", byte_run, "--max-batches", "4"])
+    assert rc == 0
+    rec = json.loads(capsys.readouterr().out.strip())
+    assert math.isfinite(rec["loss"])
+    assert rec["loss"] <= math.log(256) + 0.2
+    assert rec["perplexity"] == pytest.approx(
+        math.exp(rec["loss"]), rel=1e-3)
+    # dataset_size 16 / (batch 2 x 8 data shards) = 1 global batch.
+    assert rec["batches"] == 1
+    assert rec["tokens"] == 16 * 33  # 16 rows of seq_len+1 tokens
+    assert rec["step"] >= 1
+
+
+def test_eval_cli_dataset_override(byte_run, capsys):
+    from distributed_training_tpu import eval as eval_cli
+
+    rc = eval_cli.main([
+        "--run-dir", byte_run, "--dataset", "synthetic_lm",
+        "--dataset-kwargs",
+        json.dumps({"seq_len": 32, "vocab_size": 256, "size": 8,
+                    "seed": 9}),
+        "--batch-size", "2"])
+    assert rc == 0
+    rec = json.loads(capsys.readouterr().out.strip())
+    # 8 rows < one 16-row global batch on the 8-shard mesh: the
+    # padded fallback scores it and SAYS so.
+    assert rec["batches"] == 1
+    assert rec.get("padded") is True
