@@ -54,7 +54,6 @@ def main(argv=None) -> int:
     model_kwargs = _json.loads(args.model_kwargs)
 
     import jax
-    import jax.numpy as jnp
 
     from distributed_training_tpu.config import Config
     from distributed_training_tpu.data import (ShardedDataLoader,

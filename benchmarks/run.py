@@ -158,7 +158,6 @@ CONFIGS: dict = {
 def run_config(name: str, steps: int, warmup: int,
                full_size: bool) -> dict:
     import jax
-    import numpy as np
 
     from distributed_training_tpu.config import Config
     from distributed_training_tpu.data import build_dataset

@@ -24,7 +24,6 @@ framework's own Model protocol.
 
 from __future__ import annotations
 
-import dataclasses
 import functools
 import math
 import warnings

@@ -35,7 +35,7 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from distributed_training_tpu.runtime import (
-    AXIS_DP, AXIS_FSDP, AXIS_SP, AXIS_TP, BATCH_AXES,
+    AXIS_FSDP, AXIS_TP, BATCH_AXES,
 )
 
 logger = logging.getLogger(__name__)

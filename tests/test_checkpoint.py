@@ -3,7 +3,6 @@ the reference's resume-by-construction into save→kill→resume tests)."""
 
 import jax
 import numpy as np
-import pytest
 
 from distributed_training_tpu.checkpoint import Checkpointer
 from distributed_training_tpu.config import Config

@@ -3,7 +3,6 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
-import pytest
 
 from distributed_training_tpu.config import Config
 from distributed_training_tpu.data import (ShardedDataLoader,

@@ -8,7 +8,6 @@ newest step topology-free, and decode byte-vocab output as text.
 
 import json
 
-import numpy as np
 import pytest
 
 from distributed_training_tpu import generate as gen_cli

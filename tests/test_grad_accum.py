@@ -6,7 +6,6 @@ batch; remat drops block internals and recomputes them in backward.
 Neither may change the math — that is what these tests pin.
 """
 
-import dataclasses
 
 import jax
 import jax.numpy as jnp

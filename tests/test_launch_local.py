@@ -8,7 +8,6 @@ end-to-end (config → runtime → data → trainer → checkpoint).
 """
 
 import os
-import sys
 
 import pytest
 

@@ -4,7 +4,6 @@ activation scaling, and the fit/sharding arithmetic."""
 import os
 
 import jax
-import numpy as np
 import pytest
 
 from distributed_training_tpu.models.transformer import (Transformer,

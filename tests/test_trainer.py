@@ -5,10 +5,8 @@ the synthetic dataset, DP and FSDP layouts, convergence on the learnable
 task, replica consistency, and loss parity across strategies.
 """
 
-import dataclasses
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 import pytest
 
