@@ -162,8 +162,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
 
     @pl.when(ki == nk - 1)
     def _finalize():
-        l = l_ref[:]
-        l_safe = jnp.where(l == 0.0, 1.0, l)
+        lsum = l_ref[:]
+        l_safe = jnp.where(lsum == 0.0, 1.0, lsum)
         o_ref[0, 0] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
         lse_ref[0, 0] = m_ref[:] + jnp.log(l_safe)  # (bq, 1)
 

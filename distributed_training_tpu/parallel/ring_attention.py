@@ -100,11 +100,11 @@ def _block_attn_naive(q, k, v, mode: str, offset=None, window: int = 0):
         s = jnp.where(mask[None, None, None], s, -jnp.inf)
     m = jnp.maximum(jnp.max(s, axis=-1), NEG_INF)    # (B,Hkv,g,Sq)
     p = jnp.exp(s - m[..., None])
-    l = jnp.maximum(jnp.sum(p, axis=-1), 1e-30)
+    lsum = jnp.maximum(jnp.sum(p, axis=-1), 1e-30)
     o = jnp.einsum("bhgqk,bkhd->bhgqd", p.astype(v.dtype), v,
-                   preferred_element_type=jnp.float32) / l[..., None]
+                   preferred_element_type=jnp.float32) / lsum[..., None]
     out = o.transpose(0, 3, 1, 2, 4).reshape(B, Sq, H, D)
-    lse = (m + jnp.log(l)).reshape(B, Hkv * group, Sq)
+    lse = (m + jnp.log(lsum)).reshape(B, Hkv * group, Sq)
     return out, lse
 
 

@@ -394,7 +394,7 @@ class Trainer:
             if self._agreed_stop():
                 break
         # One host sync per epoch, not per step.
-        mean_loss = float(np.mean([float(l) for l in losses]))
+        mean_loss = float(np.mean([float(x) for x in losses]))
         return {"epoch": epoch, "mean_loss": mean_loss}
 
     def train(self, max_epochs: int | None = None) -> dict[str, float]:

@@ -35,7 +35,7 @@ def test_mlp_cpu_end_to_end():
     assert res["step_time_ms"] > 0
     assert res["samples_per_sec_per_chip"] > 0
     assert len(res["loss_curve"]) == 4
-    assert all(l > 0 for l in res["loss_curve"])
+    assert all(x > 0 for x in res["loss_curve"])
     assert "mfu" in res
 
 

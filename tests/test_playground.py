@@ -69,10 +69,10 @@ def test_norm_logging_and_rank_files(tmp_path):
     assert "local_loss" in txt0 and "|g[" in txt0
     # per-rank values must actually be per-rank (regression: out_specs
     # P() used to collapse them to one replica's value)
-    loss0 = [l.split("local_loss=")[1].split()[0]
-             for l in txt0.splitlines()]
-    loss1 = [l.split("local_loss=")[1].split()[0]
-             for l in txt1.splitlines()]
+    loss0 = [ln.split("local_loss=")[1].split()[0]
+             for ln in txt0.splitlines()]
+    loss1 = [ln.split("local_loss=")[1].split()[0]
+             for ln in txt1.splitlines()]
     assert loss0 != loss1
 
 

@@ -89,4 +89,4 @@ def test_moe_trains_under_layouts(strategy, axes):
     rt = fake_cpu_runtime(8, **axes)
     losses, _ = run_losses(rt, strategy,
                            model_kwargs=dict(moe_num_experts=4))
-    assert all(np.isfinite(l) for l in losses)
+    assert all(np.isfinite(x) for x in losses)

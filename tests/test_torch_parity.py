@@ -287,24 +287,24 @@ class _TorchTinyDecoder(torch.nn.Module):
         hd = self.wq.shape[-1]
         x = self.tok_embed[tokens] + self.pos_embed[:S]
         causal = torch.tril(torch.ones(S, S, dtype=torch.bool))
-        for l in range(L):
-            h = F_.layer_norm(x, (D,), self.ln1_scale[l],
-                              self.ln1_bias[l], 1e-5)
-            q = torch.einsum("bsd,dhk->bshk", h, self.wq[l])
-            k = torch.einsum("bsd,dhk->bshk", h, self.wk[l])
-            v = torch.einsum("bsd,dhk->bshk", h, self.wv[l])
+        for li in range(L):
+            h = F_.layer_norm(x, (D,), self.ln1_scale[li],
+                              self.ln1_bias[li], 1e-5)
+            q = torch.einsum("bsd,dhk->bshk", h, self.wq[li])
+            k = torch.einsum("bsd,dhk->bshk", h, self.wk[li])
+            v = torch.einsum("bsd,dhk->bshk", h, self.wv[li])
             logits = torch.einsum("bqhk,bmhk->bhqm", q, k) * hd ** -0.5
             logits = logits.masked_fill(~causal, float("-inf"))
             probs = torch.softmax(logits, dim=-1)
             attn = torch.einsum("bhqm,bmhk->bqhk", probs, v)
-            x = x + torch.einsum("bshk,hkd->bsd", attn, self.wo[l])
-            h = F_.layer_norm(x, (D,), self.ln2_scale[l],
-                              self.ln2_bias[l], 1e-5)
+            x = x + torch.einsum("bshk,hkd->bsd", attn, self.wo[li])
+            h = F_.layer_norm(x, (D,), self.ln2_scale[li],
+                              self.ln2_bias[li], 1e-5)
             u = F_.gelu(
-                torch.einsum("bsd,df->bsf", h, self.mlp_wi[l])
-                + self.mlp_bi[l], approximate="tanh")
-            x = x + torch.einsum("bsf,fd->bsd", u, self.mlp_wo[l]) \
-                + self.mlp_bo[l]
+                torch.einsum("bsd,df->bsf", h, self.mlp_wi[li])
+                + self.mlp_bi[li], approximate="tanh")
+            x = x + torch.einsum("bsf,fd->bsd", u, self.mlp_wo[li]) \
+                + self.mlp_bo[li]
         x = F_.layer_norm(x, (D,), self.fn_scale, self.fn_bias, 1e-5)
         return x @ self.tok_embed.T  # tied unembedding
 
