@@ -8,6 +8,9 @@
 set -u
 cd /root/repo
 export PYTHONPATH=/root/repo:/root/.axon_site
+# This session IS the legitimate chip user; bench.py's claim-the-chip
+# sweep must not kill its own ancestors (probe_loop -> this script).
+export DTT_BENCH_NO_CLAIM=1
 OUT=benchmarks/state/session_$(date -u +%Y%m%d_%H%M%S)
 mkdir -p "$OUT"
 echo "chip session -> $OUT"

@@ -16,6 +16,10 @@ if "xla_force_host_platform_device_count" not in flags:
         flags + " --xla_force_host_platform_device_count=8").strip()
 # Keep test compiles fast & deterministic.
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+# Unit tests exercise bench.main() (in-process and as a subprocess) —
+# its claim-the-chip pkill sweep must never fire against live host
+# processes from a test run.
+os.environ["DTT_BENCH_NO_CLAIM"] = "1"
 
 import jax  # noqa: E402
 

@@ -299,3 +299,28 @@ def test_analyze_trace_category_classifier():
     assert at.op_category(
         {"Operation Name": "mysterious.1"}) == "other"
     assert at.op_category({}) == "other"
+
+
+def test_claim_chip_respects_no_claim_guard(monkeypatch):
+    """DTT_BENCH_NO_CLAIM short-circuits the pkill sweep — the guard
+    that keeps chip_session.sh's own ancestors and test runs safe."""
+    import bench
+
+    calls = []
+    monkeypatch.setattr(bench.subprocess, "run",
+                        lambda *a, **k: calls.append(a))
+    monkeypatch.setenv("DTT_BENCH_NO_CLAIM", "1")
+    bench._claim_chip()
+    assert calls == []
+    # Without the guard the sweep kills every pattern then polls.
+    monkeypatch.delenv("DTT_BENCH_NO_CLAIM")
+
+    class R:
+        returncode = 1  # pgrep: nothing alive
+
+    monkeypatch.setattr(bench.subprocess, "run",
+                        lambda *a, **k: calls.append(a) or R())
+    bench._claim_chip()
+    kill_cmds = [a[0] for a in calls if a and a[0][0] == "pkill"]
+    assert len(kill_cmds) == len(bench._CLAIM_PATTERNS)
+    assert all(c[1] == "-9" for c in kill_cmds)
