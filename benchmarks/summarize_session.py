@@ -1,0 +1,75 @@
+#!/usr/bin/env python
+"""Summarize a chip_session.sh output directory into one JSON report.
+
+Collects the headline bench line, the tuning-matrix rows (best point
+first), the 1B single-chip record, and the trace analyzers' category
+rollups from ``benchmarks/state/session_*/`` — the one-command step
+between a successful harvest and committed performance.md evidence.
+
+    python benchmarks/summarize_session.py benchmarks/state/session_X
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def _json_lines(path: str) -> list[dict]:
+    if not os.path.exists(path):
+        return []
+    rows = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line.startswith("{"):
+                continue
+            try:
+                rows.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue
+    return rows
+
+
+def summarize(session_dir: str) -> dict:
+    out: dict = {"session": session_dir}
+
+    headline = _json_lines(os.path.join(session_dir, "headline.out"))
+    out["headline"] = headline[-1] if headline else None
+
+    tune = _json_lines(os.path.join(session_dir, "tune.out"))
+    ok = [r for r in tune if "mfu" in r]
+    ok.sort(key=lambda r: -r["mfu"])
+    out["tune_points"] = len(tune)
+    out["tune_errors"] = len(tune) - len(ok)
+    out["tune_best"] = ok[:3]
+
+    b1 = _json_lines(os.path.join(session_dir, "bench1b.out"))
+    out["bench_1b"] = b1[-1] if b1 else None
+
+    with os.scandir(session_dir) as it:
+        for e in it:
+            if e.name.startswith("analyze_trace") and \
+                    e.name.endswith(".json"):
+                out[e.name.removesuffix(".json")] = _json_lines(e.path)
+
+    log = os.path.join(session_dir, "session.log")
+    if os.path.exists(log):
+        with open(log) as f:
+            out["phases"] = [ln.strip() for ln in f
+                             if "rc=" in ln or "phase=" in ln][:40]
+    return out
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("session_dir")
+    args = ap.parse_args()
+    print(json.dumps(summarize(args.session_dir), indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
