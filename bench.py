@@ -34,17 +34,39 @@ _BATCH_ENV = os.environ.get("DTT_BENCH_BATCH", "32")
 # config). Sweeps override via measure(..., remat=False, ...).
 HEADLINE_MODEL_KWARGS = {"remat": True, "remat_policy": "mlp"}
 # Measured after the headline succeeds (same batch); best result wins.
-# Ordered cheap-to-risky — each gets its own salvage window, and the
-# near-certain one must not queue behind the speculative one:
-# 1) mlp-remat + moderate unroll: keeps the headline's memory plan
-#    and lets XLA fuse across layer boundaries — cheap insurance.
-# 2) Full unroll makes the stacked-layer slices static — if XLA then
-#    reuses layer buffers instead of stacking residuals, no-remat
-#    (zero recompute) may fit and beat the remat config (the
-#    estimator says 27 GiB WITH the stacking multiplier, so this only
-#    lands if the hypothesis holds).
-CONTENDER_MODEL_KWARGS = [{"scan_unroll": 4},
-                          {"remat": False, "scan_unroll": 12}]
+# Contenders measured after the headline (cheap-to-risky, each in its
+# own salvage window). MEASURED r4: the no-remat full-unroll
+# hypothesis point ({"remat": False, "scan_unroll": 12}) cannot
+# compile inside any reasonable salvage window on this 1-core host
+# (>420 s, still in XLA when the timer fired), and the salvage's
+# os._exit mid-compile leaves the PJRT client undestroyed — which is
+# exactly what wedges the tunnel for the following ~40+ min. A point
+# that can only time out and wedge the chip is negative information
+# per chip-second, so it is no longer a default; opt in via
+#   DTT_BENCH_CONTENDERS='[{"remat": false, "scan_unroll": 12}]'
+# Also measured r4: {"scan_unroll": 4} compiled+ran fine and did NOT
+# beat the headline (tok/s flat) — kept as cheap insurance.
+CONTENDER_MODEL_KWARGS = [{"scan_unroll": 4}]
+
+
+def _contenders() -> list:
+    """Contender list, env-overridable. Parsed lazily (not at import)
+    so a malformed DTT_BENCH_CONTENDERS can't crash tools that merely
+    import bench for its measurement core, and falls back to the
+    default with a stderr note naming the variable — a typo'd env var
+    must not forfeit a scarce healthy-chip window."""
+    raw = os.environ.get("DTT_BENCH_CONTENDERS")
+    if not raw:
+        return CONTENDER_MODEL_KWARGS
+    try:
+        parsed = json.loads(raw)
+        if not isinstance(parsed, list):
+            raise ValueError("expected a JSON list of kwargs objects")
+        return parsed
+    except ValueError as e:
+        print(f"[bench] malformed DTT_BENCH_CONTENDERS ignored ({e}); "
+              f"using default {CONTENDER_MODEL_KWARGS}", file=sys.stderr)
+        return CONTENDER_MODEL_KWARGS
 WARMUP_STEPS = 3
 TIMED_STEPS = 20
 PROBE_TIMEOUT_S = int(os.environ.get("DTT_BENCH_PROBE_TIMEOUT", "120"))
@@ -290,7 +312,7 @@ def run_sweep_point(batch: int, timed_steps: int = 10,
         # Record the EFFECTIVE kwargs (same merge measure() applies) so
         # an OOM row for {} reads as the headline config it actually
         # ran, not the bare default (ADVICE r3).
-        m = {"batch": batch,
+        m = {"batch": batch, "seq_len": seq_len,
              "model_kwargs": {**HEADLINE_MODEL_KWARGS, **model_kwargs},
              "error": f"{type(e).__name__}: {e}"[:300]}
     m["point_wall_s"] = round(time.perf_counter() - t0, 1)
@@ -436,7 +458,7 @@ def main() -> None:
     # contender wedges (the main watchdog would have zeroed it), and a
     # contender must be loss-finite to win (a NaN run can be fast).
     best = {"result": _result(m)}
-    for extra in CONTENDER_MODEL_KWARGS:
+    for extra in _contenders():
         # Per-contender salvage window: a slow/wedging contender must
         # not consume the shared budget and silently skip later ones.
         salvage = _arm_salvage(best)

@@ -5,6 +5,18 @@
 # benchmarks/state/session_<UTC>/ as JSON + logs.
 #
 #   pkill -f probe_loop.sh; bash benchmarks/chip_session.sh
+#
+# Ordering is information-per-chip-second, updated after the first r4
+# window measured the headline (MFU 0.2785, tok/s FLAT vs batch 8):
+#   1. mxu_roofline  — is the datasheet peak even achievable here?
+#   2. trace32       — attribute the 2x per-token gap op-by-op.
+#   3. trace8       — the original r3 gap observation, same lens.
+#   4. tune          — trimmed matrix (full-unroll points removed:
+#                      measured >420s compiles that wedge on abandon).
+#   5. bench1b       — first measured number for BASELINE config 4.
+# The headline itself is NOT re-run: measured 03:45Z this round and
+# committed in docs/performance.md; the driver re-measures it at
+# round end.
 set -u
 cd /root/repo
 export PYTHONPATH=/root/repo:/root/.axon_site
@@ -24,24 +36,24 @@ phase() {  # phase NAME TIMEOUT_S CMD...
   return $rc
 }
 
-# 1. Headline (batch32+mlp-remat vs no-remat unroll contender).
-phase headline 2400 python bench.py
+# 1. Achievable-matmul roofline (~2 min): calibrates every MFU claim.
+phase roofline 900 python benchmarks/mxu_roofline.py
 
-# 2. Full tuning matrix (cheap->expensive; survives OOM points).
-phase tune 3600 python benchmarks/tune_headline.py
-
-# 3. Traces: batch-8 (the unexplained 2x fwd gap) and the headline
-#    batch. analyze_trace runs on CPU afterwards, no chip needed.
-phase trace8 1200 python benchmarks/profile_step.py --batch 8 \
-  --trace "$OUT/trace_b8"
+# 2+3. Traces: the headline batch and the r3 gap observation. The
+#    trace analysis itself runs on CPU afterwards, no chip needed.
 phase trace32 1200 python benchmarks/profile_step.py --batch 32 \
   --model-kwargs '{"remat": true, "remat_policy": "mlp"}' \
   --trace "$OUT/trace_b32"
+phase trace8 1200 python benchmarks/profile_step.py --batch 8 \
+  --trace "$OUT/trace_b8"
 
-# 4. 1B single-chip measured run (plan: benchmarks/plan_memory.py).
+# 4. Trimmed tuning matrix (cheap->expensive; survives OOM points).
+phase tune 2400 python benchmarks/tune_headline.py
+
+# 5. 1B single-chip measured run (plan: benchmarks/plan_memory.py).
 phase bench1b 2400 python benchmarks/bench_1b_single_chip.py
 
-# 5. CPU-side trace analysis (forced off-chip).
+# 6. CPU-side trace analysis (forced off-chip).
 for t in trace_b8 trace_b32; do
   if [ -d "$OUT/$t" ]; then
     JAX_PLATFORMS=cpu timeout 600 python benchmarks/analyze_trace.py \

@@ -1,0 +1,121 @@
+#!/usr/bin/env python
+"""Measure the ACHIEVABLE bf16 matmul rate on this device, per shape.
+
+Round-4 motivation: the trained-model headline is pinned at ~68.3k
+tok/s (~0.28 MFU vs the v5e 197 TF/s datasheet peak) and is dead flat
+across batch 8->32 and remat policies — a constant per-token compute
+inefficiency. Before attributing that to the model program, this
+microbench establishes the device's empirical ceiling on the exact
+matmul shapes the model runs (qkv/proj, MLP up/down, the vocab head)
+plus big square anchors. If even a bare dot_general loop tops out far
+below datasheet peak, the gap is the platform's (tunnel / clock /
+datasheet mismatch), not the program's — and "MFU vs achievable"
+becomes the honest tuning target.
+
+Prints one JSON line per shape:
+  {"m":..,"k":..,"n":..,"tflops":..,"frac_peak":..}
+and a final summary line with the best observed rate.
+
+Usage:  python benchmarks/mxu_roofline.py [--cycles 15]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+
+# Model shapes at the headline config (GPT-2 125M, batch 32, S=1024):
+# rows = B*S tokens. Plus square anchors to catch shape-specific
+# pathologies (a bad result on EVERY shape implicates the platform).
+SHAPES = [
+    (32768, 768, 2304),    # fused qkv projection
+    (32768, 768, 768),     # attention output projection
+    (32768, 768, 3072),    # MLP up
+    (32768, 3072, 768),    # MLP down
+    (2048, 768, 50304),    # xent head chunk
+    (8192, 8192, 8192),    # big square anchor
+    (4096, 4096, 4096),    # medium square anchor
+]
+
+
+def time_shape(m: int, k: int, n: int, cycles: int) -> float:
+    """FLOP/s over a jitted scan of matmul cycles (m,k)@(k,n) ->
+    (m,n)@(n,k) -> (m,k).  One executable, one dispatch: times the
+    MXU, not the tunnel.  f32 accumulation (preferred_element_type)
+    matches the model's einsums; operands stay bf16 like the model's
+    activations/weights.  Returns achieved FLOP/s averaged over the
+    two orientations (both are shapes the model's fwd/bwd actually
+    runs: bwd dgrad/wgrad are exactly the transposed orientations)."""
+    import jax
+    import jax.numpy as jnp
+
+    key = jax.random.PRNGKey(0)
+    x0 = jax.random.normal(key, (m, k), dtype=jnp.bfloat16)
+    b = jax.random.normal(key, (k, n), dtype=jnp.bfloat16)
+    c = jax.random.normal(key, (n, k), dtype=jnp.bfloat16)
+
+    @jax.jit
+    def chain(x0, b, c):
+        def body(x, _):
+            y = jax.lax.dot_general(
+                x, b, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32).astype(jnp.bfloat16)
+            z = jax.lax.dot_general(
+                y, c, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32).astype(jnp.bfloat16)
+            return z, None
+
+        x, _ = jax.lax.scan(body, x0, None, length=cycles)
+        return x
+
+    chain(x0, b, c).block_until_ready()          # compile + warm
+    t0 = time.perf_counter()
+    chain(x0, b, c).block_until_ready()
+    dt = time.perf_counter() - t0
+    return (2.0 * m * k * n * 2 * cycles) / dt
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--cycles", type=int, default=15)
+    args = ap.parse_args()
+
+    import jax
+
+    from distributed_training_tpu.utils.metrics import peak_flops_per_chip
+
+    dev = jax.devices()[0]
+    peak = peak_flops_per_chip(dev.device_kind)
+    best = 0.0
+    for m, k, n in SHAPES:
+        try:
+            flops = time_shape(m, k, n, args.cycles)
+        except Exception as e:  # noqa: BLE001 — one bad shape != no data
+            print(json.dumps({"m": m, "k": k, "n": n,
+                              "error": f"{type(e).__name__}: {e}"[:200]}),
+                  flush=True)
+            continue
+        best = max(best, flops)
+        print(json.dumps({
+            "m": m, "k": k, "n": n,
+            "tflops": round(flops / 1e12, 1),
+            "frac_peak": round(flops / peak, 3),
+        }), flush=True)
+    print(json.dumps({
+        "metric": "achievable_bf16_matmul",
+        "device_kind": dev.device_kind,
+        "best_tflops": round(best / 1e12, 1),
+        "datasheet_peak_tflops": round(peak / 1e12, 1),
+        "best_frac_peak": round(best / peak, 3),
+    }), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

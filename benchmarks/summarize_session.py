@@ -1,7 +1,9 @@
 #!/usr/bin/env python
 """Summarize a chip_session.sh output directory into one JSON report.
 
-Collects the headline bench line, the tuning-matrix rows (best point
+Collects the roofline summary (achievable-matmul calibration), the
+headline bench line (older session layouts; the current session script
+no longer re-runs the headline), the tuning-matrix rows (best point
 first), the 1B single-chip record, and the trace analyzers' category
 rollups from ``benchmarks/state/session_*/`` — the one-command step
 between a successful harvest and committed performance.md evidence.
@@ -38,6 +40,12 @@ def summarize(session_dir: str) -> dict:
 
     headline = _json_lines(os.path.join(session_dir, "headline.out"))
     out["headline"] = headline[-1] if headline else None
+
+    roof = _json_lines(os.path.join(session_dir, "roofline.out"))
+    out["roofline_shapes"] = [r for r in roof if "metric" not in r]
+    out["roofline"] = next(
+        (r for r in roof if r.get("metric") == "achievable_bf16_matmul"),
+        None)
 
     tune = _json_lines(os.path.join(session_dir, "tune.out"))
     ok = [r for r in tune if "mfu" in r]

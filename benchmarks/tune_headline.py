@@ -8,9 +8,11 @@ flaky accelerator runtime recovers, so a single healthy window
 captures every tuning decision. Points that OOM or error emit an
 ``error`` line and the matrix continues.
 
-    python benchmarks/tune_headline.py            # full matrix
-    python benchmarks/tune_headline.py --quick    # five-point short set
-    # (r2 anchor, headline candidate, no-remat full-unroll, ceilings)
+    python benchmarks/tune_headline.py            # default matrix
+    python benchmarks/tune_headline.py --quick    # four-point short set
+    # (r2 anchor, headline candidate, batch-ceiling probes)
+    python benchmarks/tune_headline.py --unroll   # + full-unroll points
+    # (slow-compile hypothesis points, opt-in: see UNROLL_MATRIX note)
 """
 
 from __future__ import annotations
@@ -38,24 +40,26 @@ MATRIX = [
     # knob variants at the ladder's center.
     (32, {"scan_unroll": 4}),
     (32, {"flash_block_q": 512, "flash_block_k": 512}),
-    # Full unroll turns the stacked-layer scan's dynamic slices into
-    # static offsets — XLA can then reuse buffers across layers
-    # instead of stacking residuals. If that kills the measured
-    # scan-stack duplication, batch 32 may fit with NO remat (zero
-    # recompute -> the highest MFU ceiling of any point here).
+    # selective remat trades +33% recompute for the biggest batches.
+    (64, {"remat_policy": "selective"}),
+    # seq-length variant at constant tokens/step: if tok/s moves, the
+    # limiter depends on the (B, S) layout, not just token count.
+    (16, {"seq_len_override": 2048}),
+]
+# MEASURED r4: every full-unroll (scan_unroll=12) point spends >420 s
+# in XLA compilation on this 1-core host and the abandon path wedges
+# the tunnel (see bench.py CONTENDER_MODEL_KWARGS note). Opt in
+# explicitly when a long, expendable chip window exists.
+UNROLL_MATRIX = [
     (32, {"scan_unroll": 12}),
     (32, {"remat": False, "scan_unroll": 12}),
     (16, {"remat": False, "scan_unroll": 12}),
-    # selective remat trades +33% recompute for the biggest batches.
-    (64, {"remat_policy": "selective"}),
 ]
-# The five highest-information points for a short healthy-chip window:
-# r2 anchor, the headline candidate, the no-remat full-unroll
-# hypothesis, and the batch ceiling probes.
+# The highest-information points for a short healthy-chip window:
+# r2 anchor, the headline candidate, and the batch ceiling probes.
 QUICK = [
     (8, {"remat": False}),
     (32, {}),
-    (32, {"remat": False, "scan_unroll": 12}),
     (48, {}),
     (64, {}),
 ]
@@ -64,16 +68,22 @@ QUICK = [
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--unroll", action="store_true",
+                    help="append the slow-compile full-unroll points")
     ap.add_argument("--timed-steps", type=int, default=10)
     args = ap.parse_args()
     points = QUICK if args.quick else MATRIX
+    if args.unroll:
+        points = points + UNROLL_MATRIX
     for batch, kwargs in points:
         # warmup 2 (vs the headline's 3): the matrix pays one fewer
         # compiled step per point; steady-state step time is reached
         # after the first post-compile step.
+        kwargs = dict(kwargs)
+        seq_len = kwargs.pop("seq_len_override", 1024)
         print(json.dumps(run_sweep_point(
             batch, timed_steps=args.timed_steps, warmup_steps=2,
-            **kwargs)), flush=True)
+            seq_len=seq_len, **kwargs)), flush=True)
 
 
 if __name__ == "__main__":

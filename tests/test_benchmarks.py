@@ -127,6 +127,12 @@ def test_bench_contender_wins_when_faster(monkeypatch, capsys):
 
     monkeypatch.setattr(bench, "probe_backend", lambda: None)
     monkeypatch.setattr(bench, "_resolve_batch", lambda: 16)
+    # Pin the contender list: the default is env-configurable (the
+    # full-unroll point was demoted after it wedged the r4 chip), and
+    # this test's semantics are about win/crash/NaN handling, not the
+    # current default set.
+    monkeypatch.setattr(bench, "CONTENDER_MODEL_KWARGS",
+                        [{"scan_unroll": 12}])
 
     def fake_measure(batch, **kw):
         if kw.get("scan_unroll") == 12:
@@ -254,7 +260,7 @@ def test_tune_headline_matrix_plumbing(monkeypatch, capsys):
     def fake_measure(batch, seq_len=1024, timed_steps=10,
                      warmup_steps=2, phase=None, **kw):
         seen.append((batch, dict(kw)))
-        if kw.get("scan_unroll") == 12 and not kw.get("remat", True):
+        if batch == 64:  # the ceiling probe fake-OOMs
             raise RuntimeError("RESOURCE_EXHAUSTED: fake OOM")
         return {"mfu": 0.3, "batch": batch, "loss_finite": True,
                 "model_kwargs": kw}
@@ -267,11 +273,25 @@ def test_tune_headline_matrix_plumbing(monkeypatch, capsys):
     assert len(rows) == len(tune_headline.QUICK)
     assert len(seen) == len(tune_headline.QUICK)
     errors = [r for r in rows if "error" in r]
-    # The no-remat full-unroll point fake-OOMs; its error row carries
-    # the merged kwargs so sweep analysis sees what actually ran.
+    # The batch-64 ceiling probe fake-OOMs; its error row carries the
+    # merged kwargs so sweep analysis sees what actually ran.
     assert len(errors) == 1
-    assert errors[0]["model_kwargs"]["scan_unroll"] == 12
+    assert errors[0]["batch"] == 64
+    assert "remat_policy" in errors[0]["model_kwargs"]  # merged headline
     assert all("point_wall_s" in r for r in rows)
+
+    # --unroll appends the slow-compile hypothesis points (demoted from
+    # the default matrix after the r4 wedge) without duplicating QUICK.
+    seen.clear()
+    monkeypatch.setattr(
+        sys, "argv", ["tune_headline.py", "--quick", "--unroll"])
+    tune_headline.main()
+    rows2 = [json.loads(ln)
+             for ln in capsys.readouterr().out.strip().splitlines()]
+    assert len(rows2) == len(tune_headline.QUICK) + len(
+        tune_headline.UNROLL_MATRIX)
+    assert any(r.get("model_kwargs", {}).get("scan_unroll") == 12
+               for r in rows2)
 
 
 def test_analyze_trace_category_classifier():
