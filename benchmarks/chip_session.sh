@@ -14,6 +14,7 @@
 #   4. tune          — trimmed matrix (full-unroll points removed:
 #                      measured >420s compiles that wedge on abandon).
 #   5. bench1b       — first measured number for BASELINE config 4.
+#   6. resnet        — first measured number for BASELINE config 2.
 # The headline itself is NOT re-run: measured 03:45Z this round and
 # committed in docs/performance.md; the driver re-measures it at
 # round end.
@@ -53,7 +54,11 @@ phase tune 2400 python benchmarks/tune_headline.py
 # 5. 1B single-chip measured run (plan: benchmarks/plan_memory.py).
 phase bench1b 2400 python benchmarks/bench_1b_single_chip.py
 
-# 6. CPU-side trace analysis (forced off-chip).
+# 6. BASELINE config 2 (ResNet-18): first measured chip number for the
+#    conv family (dp shrinks to the local device count).
+phase resnet 1200 python benchmarks/run.py --config resnet18_ddp --steps 20
+
+# 7. CPU-side trace analysis (forced off-chip).
 for t in trace_b8 trace_b32; do
   if [ -d "$OUT/$t" ]; then
     JAX_PLATFORMS=cpu timeout 600 python benchmarks/analyze_trace.py \

@@ -35,6 +35,29 @@ def _json_lines(path: str) -> list[dict]:
     return rows
 
 
+def _json_doc(path: str) -> dict | None:
+    """Parse a file that holds ONE JSON document — possibly
+    pretty-printed (run.py emits indent=2, which the line parser
+    cannot see). Falls back to the last line-mode record."""
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        text = f.read()
+    # Candidate document starts: every line-initial '{' (log lines may
+    # precede the payload). Try the latest first — the record of
+    # interest is the final thing the tool printed.
+    starts = [i for i in (0, *(j + 1 for j, ch in enumerate(text)
+                               if ch == "\n"))
+              if text[i:i + 1] == "{"]
+    for start in reversed(starts):
+        try:
+            return json.loads(text[start:])
+        except json.JSONDecodeError:
+            continue
+    rows = _json_lines(path)
+    return rows[-1] if rows else None
+
+
 def summarize(session_dir: str) -> dict:
     out: dict = {"session": session_dir}
 
@@ -56,6 +79,8 @@ def summarize(session_dir: str) -> dict:
 
     b1 = _json_lines(os.path.join(session_dir, "bench1b.out"))
     out["bench_1b"] = b1[-1] if b1 else None
+
+    out["resnet18"] = _json_doc(os.path.join(session_dir, "resnet.out"))
 
     with os.scandir(session_dir) as it:
         for e in it:

@@ -344,3 +344,29 @@ def test_claim_chip_respects_no_claim_guard(monkeypatch):
     kill_cmds = [a[0] for a in calls if a and a[0][0] == "pkill"]
     assert len(kill_cmds) == len(bench._CLAIM_PATTERNS)
     assert all(c[1] == "-9" for c in kill_cmds)
+
+
+def test_summarize_session_collects_all_phase_outputs(tmp_path):
+    """The harvest report must see every phase's evidence format:
+    compact JSON lines (roofline/tune/bench1b) AND run.py's
+    pretty-printed single document (resnet), including a log-line
+    prefix before the payload."""
+    import summarize_session as ss
+
+    (tmp_path / "roofline.out").write_text(
+        '{"m": 32768, "k": 768, "n": 2304, "tflops": 90.0}\n'
+        '{"metric": "achievable_bf16_matmul", "best_tflops": 110.0}\n')
+    (tmp_path / "tune.out").write_text(
+        '{"mfu": 0.28, "batch": 32}\n'
+        '{"batch": 64, "error": "RESOURCE_EXHAUSTED", '
+        '"model_kwargs": {}}\n')
+    (tmp_path / "resnet.out").write_text(
+        'compiling... {elapsed}\n{\n  "config": "resnet18_ddp",\n'
+        '  "mfu": 0.11\n}\n')
+    s = ss.summarize(str(tmp_path))
+    assert s["roofline"]["best_tflops"] == 110.0
+    assert len(s["roofline_shapes"]) == 1
+    assert s["tune_points"] == 2 and s["tune_errors"] == 1
+    assert s["tune_best"][0]["mfu"] == 0.28
+    assert s["resnet18"]["config"] == "resnet18_ddp"
+    assert s["headline"] is None and s["bench_1b"] is None
