@@ -89,14 +89,77 @@ def _phase(name: str, **kv) -> None:
           flush=True)
 
 
+# Committed ledger of in-session measurements. A wedged chip at the
+# driver's end-of-round run must not erase a number that WAS measured
+# on real hardware earlier (r3: an 8h wedge zeroed the round even
+# though the code had been measured that session) — the failure record
+# carries the newest ledger entry, clearly labeled as prior evidence.
+EVIDENCE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "benchmarks", "evidence")
+
+
+def _latest_evidence() -> dict | None:
+    """Newest ledger entry by its recorded measurement time (filename
+    order is meaningless across committed seeds + runtime writes)."""
+    best = None
+    try:
+        names = os.listdir(EVIDENCE_DIR)
+    except OSError:
+        return None
+    for name in names:
+        if not name.endswith(".json"):
+            continue
+        try:
+            with open(os.path.join(EVIDENCE_DIR, name)) as f:
+                rec = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if not isinstance(rec, dict):
+            continue
+        if best is None or rec.get("measured_at_unix", 0) > \
+                best.get("measured_at_unix", 0):
+            best = rec
+    return best
+
+
+def record_evidence(result: dict) -> None:
+    """Persist a successful measurement to the committed ledger
+    (best-effort; measurement must never fail on a ledger write).
+
+    Only results carrying a hardware identity are recorded: unit tests
+    drive main() with stubbed measure() functions whose results have no
+    ``detail.device_kind``, and a stub result in the ledger would later
+    surface as fake "prior hardware evidence" in a failure record
+    (caught in review — it had already happened). Atomic replace so an
+    external kill mid-write can't destroy the previous good entry."""
+    if not isinstance(result, dict) or not result.get(
+            "detail", {}).get("device_kind"):
+        return
+    try:
+        os.makedirs(EVIDENCE_DIR, exist_ok=True)
+        path = os.path.join(EVIDENCE_DIR, "last_good.json")
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({**result,
+                       "measured_at_unix": int(time.time())}, f,
+                      indent=1)
+        os.replace(tmp, path)
+    except OSError:
+        pass
+
+
 def _failure_record(stage: str, message: str) -> dict:
-    return {
+    rec = {
         "metric": "gpt2_125m_train_mfu_single_chip",
         "value": 0.0,
         "unit": "mfu",
         "vs_baseline": 0.0,
         "error": {"stage": stage, "message": message[:500]},
     }
+    prior = _latest_evidence()
+    if prior is not None:
+        rec["last_measured"] = prior
+    return rec
 
 
 def _fail(stage: str, message: str) -> None:
@@ -210,6 +273,7 @@ def _arm_salvage(holder: dict):
 
     def fire():
         _phase("salvage_fired", budget_s=CONTENDER_TIMEOUT_S)
+        record_evidence(holder["result"])
         print(json.dumps(holder["result"]), flush=True)
         os._exit(0)
 
@@ -458,6 +522,10 @@ def main() -> None:
     # contender wedges (the main watchdog would have zeroed it), and a
     # contender must be loss-finite to win (a NaN run can be fast).
     best = {"result": _result(m)}
+    # Ledger write the moment the headline exists: a contender that
+    # hard-crashes the process (native abort, no salvage window) must
+    # not take the already-measured number with it.
+    record_evidence(best["result"])
     for extra in _contenders():
         # Per-contender salvage window: a slow/wedging contender must
         # not consume the shared budget and silently skip later ones.
@@ -472,7 +540,9 @@ def main() -> None:
             _phase("contender_failed", error=f"{type(e).__name__}")
         finally:
             salvage.cancel()
-    print(json.dumps(_result(m)))
+    final = _result(m)
+    record_evidence(final)
+    print(json.dumps(final))
 
 
 if __name__ == "__main__":
