@@ -247,7 +247,13 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         q = q_ref[0, 0]
         k = k_ref[0, 0]
         v = v_ref[0, 0]
-        do = do_ref[0, 0].astype(jnp.float32)
+        # MXU operands stay in the INPUT dtype (bf16 for the model
+        # path); only accumulation is f32. Upcasting `do` here made
+        # the dp matmul run f32xf32 — fractional MXU rate for zero
+        # numerics benefit (the f32 work was discarded into a bf16-
+        # rounded ds anyway). FlashAttention-2 semantics: bf16
+        # operands, f32 accumulate, f32 softmax statistics.
+        do = do_ref[0, 0].astype(v.dtype)
         lse = lse_ref[0, 0]                       # (bq, 1)
         delta = delta_ref[0, 0]                   # (bq, 1)
         s = jax.lax.dot_general(
@@ -256,9 +262,9 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         if causal:
             s = _apply_causal_mask(s, q_start, k_start, block_q,
                                    block_k, window)
-        p = jnp.exp(s - lse)                       # (bq, bk)
+        p = jnp.exp(s - lse)                       # (bq, bk) f32
         dp = jax.lax.dot_general(
-            do, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
+            do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
         ds = p * (dp - delta) * scale
         dq_acc[:] += jax.lax.dot_general(
@@ -292,7 +298,10 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         q = q_ref[0, 0]
         k = k_ref[0, 0]
         v = v_ref[0, 0]
-        do = do_ref[0, 0].astype(jnp.float32)
+        # Same operand-dtype discipline as the dq kernel (see note
+        # there): p is rounded to the input dtype for the dv matmul
+        # exactly as the forward rounds p for the pv matmul.
+        do = do_ref[0, 0].astype(v.dtype)
         lse = lse_ref[0, 0]                       # (bq, 1)
         delta = delta_ref[0, 0]                   # (bq, 1)
         s = jax.lax.dot_general(
@@ -301,12 +310,12 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         if causal:
             s = _apply_causal_mask(s, q_start, k_start, block_q,
                                    block_k, window)
-        p = jnp.exp(s - lse)                       # (bq, bk)
+        p = jnp.exp(s - lse)                       # (bq, bk) f32
         dv_acc[:] += jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)    # (bk, d)
         dp = jax.lax.dot_general(
-            do, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
+            do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
         ds = p * (dp - delta) * scale              # (bq, bk)
         dk_acc[:] += jax.lax.dot_general(
