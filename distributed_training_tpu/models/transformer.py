@@ -156,11 +156,18 @@ class TransformerConfig:
         return self.d_model // self.n_heads
 
 
-# Allow-list for remat_policy="mlp": every D-wide tag _block emits.
-# The F-wide MLP hiddens are the only block intermediates NOT here —
-# they are the recompute this policy trades for HBM.
+# Allow-list for remat_policy="mlp": every D-wide tag _block emits,
+# PLUS the flash kernel's custom-VJP residuals (flash_out/flash_lse,
+# named in ops/flash_attention._flash_bhsd_fwd) — without them the
+# backward re-runs the forward attention kernel even though attn_out
+# itself is saved (measured r4: 31.8 ms/step of rematted pallas_call
+# at batch 32). The F-wide MLP hiddens are the only block
+# intermediates NOT here — they are the recompute this policy trades
+# for HBM.
+FLASH_RESIDUAL_NAMES = ("flash_out", "flash_lse")
 MLP_POLICY_SAVED = ("ln1_out", "q_rope", "k_rope", "v_proj",
-                    "attn_out", "resid_attn", "ln2_out")
+                    "attn_out", "resid_attn", "ln2_out",
+                    *FLASH_RESIDUAL_NAMES)
 
 # Reference hyperparameters for the BASELINE.json ladder. Vocab is
 # GPT-2's 50257 padded to 50304 (next multiple of 128): lane-aligned
@@ -241,6 +248,26 @@ class Transformer:
         if self.mesh is None:
             return {}
         return dict(zip(self.mesh.axis_names, self.mesh.devices.shape))
+
+    def _flash_active(self) -> bool:
+        """Will attention run through the Pallas flash custom-VJP?
+
+        Trace-time mirror of the dispatch in ops/attention.py: True
+        for impl='flash', and for 'auto' on a TPU backend (the ring
+        and ulysses layouts route their per-block attention through
+        the same kernels). Used to pick which attention-output name
+        the remat allow-lists save. Edge case (documented, cheap-not-
+        wrong): 'auto' on TPU with tile-unfriendly shapes demotes to
+        naive per-shape, in which case the saved flash names don't
+        exist and the backward recomputes attention from the saved
+        q/k/v tags — extra FLOPs, identical numerics."""
+        from distributed_training_tpu.ops.flash_attention import (
+            _platform_is_tpu,
+        )
+        impl = self.cfg.attention_impl
+        if impl == "naive":
+            return False
+        return impl != "auto" or _platform_is_tpu()
 
     def _attention(self, q, k, v):
         c = self.cfg
@@ -642,13 +669,29 @@ class Transformer:
             if c.remat:
                 # Values validated in __post_init__; "full" → default
                 # save-nothing policy. Allow-lists only: see the
-                # checkpoint_name comment in _block.
+                # checkpoint_name comment in _block. The attention
+                # output exists under two names — attn_out (BSHD, the
+                # model-side tag) and flash_out (BHSD, the kernel's
+                # custom-VJP residual) — and saving both would store
+                # the same values twice (~B*S*D*2 bytes/layer). Save
+                # whichever layout the active backward actually
+                # consumes: flash's VJP needs its own residuals (the
+                # BSHD twin is then one cheap transpose away), the
+                # naive path has no flash residuals at all.
+                if self._flash_active():
+                    attn_names = FLASH_RESIDUAL_NAMES
+                else:
+                    attn_names = ("attn_out",)
                 if c.remat_policy == "selective":
                     policy = (jax.checkpoint_policies
-                              .save_only_these_names("attn_out"))
+                              .save_only_these_names(*attn_names))
                 elif c.remat_policy == "mlp":
+                    saved = tuple(
+                        n for n in MLP_POLICY_SAVED
+                        if n not in ("attn_out", *FLASH_RESIDUAL_NAMES)
+                    ) + attn_names
                     policy = (jax.checkpoint_policies
-                              .save_only_these_names(*MLP_POLICY_SAVED))
+                              .save_only_these_names(*saved))
                 else:
                     policy = None
                 block = jax.checkpoint(block, prevent_cse=False,

@@ -48,6 +48,7 @@ from __future__ import annotations
 import functools
 
 import jax
+import jax.ad_checkpoint
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 from jax.experimental.shard_map import shard_map
@@ -137,13 +138,14 @@ def _flash_block_ok(q, k, block_impl: str, block_q: int = 0,
     if block_impl == "naive":
         return False
     if block_impl == "flash":
-        bq = min(block_q or fa.DEFAULT_BLOCK_Q, S)
-        bk = min(block_k or fa.DEFAULT_BLOCK_K, Sk)
-        if S % bq or Sk % bk:
+        bq, bk = fa._resolve_blocks(block_q, block_k, S, Sk,
+                                    q.shape[3])
+        if not bq or not bk or S % bq or Sk % bk:
             raise ValueError(
                 f"block_impl='flash' forced but local shard lengths "
-                f"({S}, {Sk}) are not divisible by the kernel tiles "
-                f"({bq}, {bk}); pad the sequence or use 'auto'")
+                f"({S}, {Sk}) admit no dividing kernel tile "
+                f"(resolved ({bq}, {bk}), 0 = none fits VMEM); pad "
+                f"the sequence or use 'auto'")
         if q.shape[2] % k.shape[2]:
             # A non-dividing group would make the kernel's h // reps
             # KV index map read out-of-range blocks (Pallas clamps —
@@ -168,11 +170,11 @@ def _bhsd(x):
 
 
 def _flash_blocks(qt, block_q: int = 0, block_k: int = 0):
-    """Tile sizes for a (B,H,S,D)-layout ring block (0 → module
-    defaults, clamped to the local shard length)."""
+    """Tile sizes for a (B,H,S,D)-layout ring block (0 → the measured
+    seq-aware kernel defaults, clamped to the local shard length)."""
     from distributed_training_tpu.ops import flash_attention as fa
-    return (min(block_q or fa.DEFAULT_BLOCK_Q, qt.shape[2]),
-            min(block_k or fa.DEFAULT_BLOCK_K, qt.shape[2]))
+    return fa._resolve_blocks(block_q, block_k, qt.shape[2],
+                              qt.shape[2], qt.shape[3])
 
 
 def _block_attn_flash(qt, k, v, mode: str, block_q: int = 0,
@@ -366,7 +368,18 @@ def _ring_core_fwd(q, k, v, axis_name, causal, block_impl,
                    block_q=0, block_k=0, window=0):
     out, lse = _ring_fwd_scan(q, k, v, axis_name, causal, block_impl,
                               block_q, block_k, window)
-    return out, (q, k, v, out, lse)
+    # Checkpoint-name the residuals the reverse ring consumes (same
+    # discipline as ops/flash_attention._flash_bhsd_fwd): un-named
+    # custom-VJP residuals are dropped by save_only_these_names remat
+    # policies, and the "recompute" here is the ENTIRE forward ring —
+    # sp ppermute rotations riding ICI — not just a local kernel.
+    # The model's policy allow-lists carry these names
+    # (models/transformer.FLASH_RESIDUAL_NAMES). Primal and residual
+    # share the named value — see the note in
+    # ops/flash_attention._flash_bhsd_fwd.
+    name = jax.ad_checkpoint.checkpoint_name
+    out = name(out, "flash_out")
+    return out, (q, k, v, out, name(lse, "flash_lse"))
 
 
 def _ring_core_bwd(axis_name, causal, block_impl, block_q, block_k,

@@ -125,3 +125,32 @@ def test_dispatch_auto_rejects_non_dividing_tile_override():
         dot_product_attention(q, k, v, impl="auto", block_k=96)
     # A dividing override stays legal.
     dot_product_attention(q, k, v, impl="auto", block_q=128, block_k=128)
+
+
+def test_fused_bwd_matches_two_pass(monkeypatch):
+    """The fused single-sweep backward (dq/dk/dv in one kernel, full
+    (S, D) dq scratch) must produce the same gradients as the split
+    FlashAttention-2 dq/dkv kernels it replaces on small-S shapes —
+    including GQA group reduction and sliding windows. The split path
+    is forced by shrinking the fused path's VMEM scratch budget."""
+    from distributed_training_tpu.ops import flash_attention as fa
+
+    def grads(**kw):
+        q, k, v = rand_qkv(B=2, S=256, H=4, D=16, Hkv=2, seed=3)
+
+        def f(q, k, v):
+            return jnp.sum(flash_attention(
+                q, k, v, causal=True, block_q=64, block_k=64,
+                **kw) ** 2)
+
+        return jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+
+    for kw in ({}, {"window": 96}):
+        fused = grads(**kw)
+        assert fa._FUSED_BWD_DQ_RESIDENT_LIMIT_BYTES >= 256 * 16 * 8
+        monkeypatch.setattr(fa, "_FUSED_BWD_DQ_RESIDENT_LIMIT_BYTES", 0)
+        split = grads(**kw)
+        monkeypatch.undo()
+        for a, b in zip(fused, split):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-5, atol=2e-5)
