@@ -1,28 +1,33 @@
 #!/bin/bash
-# One healthy-chip window → every round-4 measurement, sequentially
-# (never two TPU processes at once). Run when chip_status says ALIVE,
-# with probe_loop.sh STOPPED first. All evidence lands under
-# benchmarks/state/session_<UTC>/ as JSON + logs.
+# One healthy-chip window → the current highest-value measurements,
+# sequentially (never two TPU processes at once). Fired automatically
+# by benchmarks/probe_loop.sh on wedge recovery, or by hand when
+# chip_status says ALIVE (stop probe_loop first):
 #
 #   pkill -f probe_loop.sh; bash benchmarks/chip_session.sh
 #
-# Ordering is information-per-chip-second, updated after the first r4
-# window measured the headline (MFU 0.2785, tok/s FLAT vs batch 8):
-#   1. mxu_roofline  — is the datasheet peak even achievable here?
-#   2. trace32       — attribute the 2x per-token gap op-by-op.
-#   3. trace8       — the original r3 gap observation, same lens.
-#   4. tune          — trimmed matrix (full-unroll points removed:
-#                      measured >420s compiles that wedge on abandon).
-#   5. bench1b       — first measured number for BASELINE config 4.
-#   6. resnet        — first measured number for BASELINE config 2.
-# The headline itself is NOT re-run: measured 03:45Z this round and
-# committed in docs/performance.md; the driver re-measures it at
-# round end.
+# Ordering is information-per-chip-second. State after the r4 window-4
+# session (see docs/performance.md measured history): headline 0.427
+# MFU via seq-aware flash tiles + remat residual fix; ladder mostly
+# banked. What the next window must answer:
+#   1. headline    — re-confirm 0.427 on the FINAL committed code (the
+#                    review pass de-duplicated saved attention
+#                    residuals after the 0.427 run; memory-neutral on
+#                    the hot path, but confirm + bank via the evidence
+#                    ledger).
+#   2. trace32     — attribute the remaining gap (0.43 -> 1.0) with
+#                    the new kernel geometry in place.
+#   3. bench1b     — 1B now rides the 1024 tiles too (was 0.320 with
+#                    256-tile kernels).
+#   4. long2k      — seq 2048 at the new defaults (banked 0.322 with
+#                    512-tile overrides).
+# Known traps, demoted: batch-64 dies in the platform's remote compile
+# helper (HTTP 500); batch-32 no-remat hangs >1800 s in compile — do
+# NOT re-attempt either in an automated window, and never let a phase
+# timeout kill a mid-compile process without expecting a ~40 min wedge.
 set -u
 cd /root/repo
 export PYTHONPATH=/root/repo:/root/.axon_site
-# This session IS the legitimate chip user; bench.py's claim-the-chip
-# sweep must not kill its own ancestors (probe_loop -> this script).
 export DTT_BENCH_NO_CLAIM=1
 OUT=benchmarks/state/session_$(date -u +%Y%m%d_%H%M%S)
 mkdir -p "$OUT"
@@ -37,33 +42,17 @@ phase() {  # phase NAME TIMEOUT_S CMD...
   return $rc
 }
 
-# 1. Achievable-matmul roofline (~2 min): calibrates every MFU claim.
-phase roofline 900 python benchmarks/mxu_roofline.py
-
-# 2+3. Traces: the headline batch and the r3 gap observation. The
-#    trace analysis itself runs on CPU afterwards, no chip needed.
+phase headline 1500 python bench.py
 phase trace32 1200 python benchmarks/profile_step.py --batch 32 \
   --model-kwargs '{"remat": true, "remat_policy": "mlp"}' \
   --trace "$OUT/trace_b32"
-phase trace8 1200 python benchmarks/profile_step.py --batch 8 \
-  --trace "$OUT/trace_b8"
-
-# 4. Trimmed tuning matrix (cheap->expensive; survives OOM points).
-phase tune 2400 python benchmarks/tune_headline.py
-
-# 5. 1B single-chip measured run (plan: benchmarks/plan_memory.py).
 phase bench1b 2400 python benchmarks/bench_1b_single_chip.py
+phase long2k 1200 python benchmarks/tune_headline.py --points \
+  '[[16, {"seq_len_override": 2048, "max_seq_len": 2048}]]'
 
-# 6. BASELINE config 2 (ResNet-18): first measured chip number for the
-#    conv family (dp shrinks to the local device count).
-phase resnet 1200 python benchmarks/run.py --config resnet18_ddp --steps 20
-
-# 7. CPU-side trace analysis (forced off-chip).
-for t in trace_b8 trace_b32; do
-  if [ -d "$OUT/$t" ]; then
-    JAX_PLATFORMS=cpu timeout 600 python benchmarks/analyze_trace.py \
-      "$OUT/$t" --json >"$OUT/analyze_$t.json" 2>>"$OUT/session.log"
-  fi
-done
-
+# CPU-side trace analysis (forced off-chip).
+if [ -d "$OUT/trace_b32" ]; then
+  JAX_PLATFORMS=cpu timeout 600 python benchmarks/analyze_trace.py \
+    "$OUT/trace_b32" --json >"$OUT/analyze_trace_b32.json" 2>>"$OUT/session.log"
+fi
 echo "[session] done $(date -u +%H:%M:%S)" | tee -a "$OUT/session.log"

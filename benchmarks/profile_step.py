@@ -69,9 +69,11 @@ def main(argv=None) -> int:
     cfg.train.dtype = args.dtype
     cfg.train.log_every = 0
     rt = initialize_runtime(cfg)
-    model = build_model(args.model, dtype=args.dtype,
-                        attention_impl=args.attention, remat=args.remat,
-                        **model_kwargs)
+    # --model-kwargs wins over the convenience flags; a duplicated key
+    # (e.g. remat both places) must merge, not TypeError the harvest.
+    model_kwargs = {"attention_impl": args.attention,
+                    "remat": args.remat, **model_kwargs}
+    model = build_model(args.model, dtype=args.dtype, **model_kwargs)
     ds = SyntheticLMDataset(size=max(64, args.batch),
                             seq_len=args.seq_len,
                             vocab_size=args.vocab_size, seed=0)

@@ -71,10 +71,16 @@ def main() -> None:
     ap.add_argument("--unroll", action="store_true",
                     help="append the slow-compile full-unroll points")
     ap.add_argument("--timed-steps", type=int, default=10)
+    ap.add_argument("--points", default=None,
+                    help="JSON [[batch, kwargs], ...] — run this ad-hoc "
+                         "matrix instead of the built-in one (single "
+                         "process, one backend init for the window)")
     args = ap.parse_args()
     points = QUICK if args.quick else MATRIX
     if args.unroll:
         points = points + UNROLL_MATRIX
+    if args.points:
+        points = [(int(b), dict(kw)) for b, kw in json.loads(args.points)]
     for batch, kwargs in points:
         # warmup 2 (vs the headline's 3): the matrix pays one fewer
         # compiled step per point; steady-state step time is reached

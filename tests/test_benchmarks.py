@@ -417,3 +417,67 @@ def test_failure_record_carries_prior_evidence(tmp_path, monkeypatch):
     rec = bench._failure_record("measure", "oom")
     assert rec["last_measured"]["value"] == 0.28
     assert rec["value"] == 0.0  # the failure itself is still a failure
+
+
+def test_tune_headline_ad_hoc_points(monkeypatch, capsys):
+    """--points replaces the built-in matrix with a JSON-specified one
+    (the chip-window driver uses it for follow-up sweeps) and each
+    point's kwargs reach the measurement core intact."""
+    import bench
+    import tune_headline
+
+    seen = []
+
+    def fake_measure(batch, seq_len=1024, timed_steps=10,
+                     warmup_steps=2, phase=None, **kw):
+        seen.append((batch, seq_len, dict(kw)))
+        return {"mfu": 0.3, "batch": batch, "loss_finite": True,
+                "model_kwargs": kw}
+
+    monkeypatch.setattr(bench, "measure", fake_measure)
+    pts = ('[[32, {"flash_block_q": 1024}], '
+           '[16, {"seq_len_override": 2048, "max_seq_len": 2048}]]')
+    monkeypatch.setattr(sys, "argv",
+                        ["tune_headline.py", "--points", pts])
+    tune_headline.main()
+    rows = [json.loads(ln) for ln in
+            capsys.readouterr().out.strip().splitlines()]
+    assert len(rows) == 2 == len(seen)
+    assert seen[0][0] == 32 and seen[0][2]["flash_block_q"] == 1024
+    # seq_len_override is popped into the seq_len argument; the rest
+    # of the kwargs (max_seq_len here) flow through to build_model.
+    assert seen[1][1] == 2048
+    assert seen[1][2] == {"max_seq_len": 2048}
+
+
+def test_audit_matmuls_tiny_model_all_bf16():
+    """The offline dot_general audit (benchmarks/audit_matmuls.py) on a
+    tiny flash-forced model: every dot in the step is bf16 x bf16 (the
+    TPU program's MXU discipline — this is the check that caught the
+    flash-backward f32 upcasts), totals are positive, and the naive
+    path's known mixed-precision bwd dots are visible when forced."""
+    import audit_matmuls
+
+    rep = audit_matmuls.audit(2, 256, {
+        "attention_impl": "flash", "n_layers": 2, "d_model": 128,
+        "n_heads": 4, "vocab_size": 512, "max_seq_len": 256})
+    assert rep["n_dots"] > 0 and rep["total_dot_flops"] > 0
+    assert set(rep["flops_by_dtype_pair"]) == {"bfloat16xbfloat16"}
+    assert rep["f32_offenders"] == []
+
+
+def test_profile_step_merges_duplicate_model_kwargs(capsys):
+    """--model-kwargs carrying remat/attention_impl must merge with the
+    convenience flags, not TypeError (this crashed the r4 trace32
+    harvest two seconds into a healthy chip window)."""
+    import profile_step
+
+    rc = profile_step.main([
+        "--batch", "2", "--seq-len", "128", "--iters", "1",
+        "--vocab-size", "256",
+        "--model-kwargs",
+        '{"remat": true, "remat_policy": "mlp", "n_layers": 2, '
+        '"d_model": 64, "n_heads": 2, "max_seq_len": 128, '
+        '"vocab_size": 256}'])
+    assert rc == 0
+    assert "step mfu" in capsys.readouterr().out
