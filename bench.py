@@ -44,9 +44,13 @@ HEADLINE_MODEL_KWARGS = {"remat": True, "remat_policy": "mlp"}
 # that can only time out and wedge the chip is negative information
 # per chip-second, so it is no longer a default; opt in via
 #   DTT_BENCH_CONTENDERS='[{"remat": false, "scan_unroll": 12}]'
-# Also measured r4: {"scan_unroll": 4} compiled+ran fine and did NOT
-# beat the headline (tok/s flat) — kept as cheap insurance.
-CONTENDER_MODEL_KWARGS = [{"scan_unroll": 4}]
+# Also measured r4: {"scan_unroll": 4} compiled+ran fine and LOST to
+# the headline outright (0.249 vs 0.427 MFU after the seq-aware flash
+# tiles landed) — a contender with a measured loss is pure chip-window
+# waste, so the default list is now empty; the headline config IS the
+# tuned winner of the r4 matrix. Opt contenders back in via
+# DTT_BENCH_CONTENDERS when there is a new hypothesis to race.
+CONTENDER_MODEL_KWARGS: list = []
 
 
 def _contenders() -> list:

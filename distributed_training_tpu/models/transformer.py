@@ -196,14 +196,20 @@ def _dropout(x: jax.Array, rng: jax.Array, rate: float) -> jax.Array:
                      jnp.zeros((), x.dtype)).astype(x.dtype)
 
 
-def _rope(q: jax.Array, k: jax.Array, positions: jax.Array) -> tuple:
-    """Rotary position embedding on (B, S, H, D) q/k."""
+def _rope(q: jax.Array, k: jax.Array, positions: jax.Array,
+          layout: str = "bshd") -> tuple:
+    """Rotary position embedding on (B, S, H, D) or (B, H, S, D) q/k
+    (``layout``: the sequence axis is 1 or 2 respectively)."""
     D = q.shape[-1]
     half = D // 2
     freqs = 1.0 / (10000 ** (jnp.arange(half, dtype=jnp.float32) / half))
     angles = positions[:, None].astype(jnp.float32) * freqs[None, :]
-    cos = jnp.cos(angles)[None, :, None, :]  # (1, S, 1, half)
-    sin = jnp.sin(angles)[None, :, None, :]
+    if layout == "bhsd":
+        cos = jnp.cos(angles)[None, None, :, :]  # (1, 1, S, half)
+        sin = jnp.sin(angles)[None, None, :, :]
+    else:
+        cos = jnp.cos(angles)[None, :, None, :]  # (1, S, 1, half)
+        sin = jnp.sin(angles)[None, :, None, :]
 
     def rot(x):
         x1, x2 = x[..., :half], x[..., half:]
@@ -269,7 +275,23 @@ class Transformer:
             return False
         return impl != "auto" or _platform_is_tpu()
 
-    def _attention(self, q, k, v):
+    def _bhsd_fast(self) -> bool:
+        """Run the block's attention segment natively in (B, H, S, D)?
+
+        The flash kernels work in BHSD; with the model's default BSHD
+        einsum layout the wrapper transposes q/k/v in and the output
+        back out every layer — and the backward recomputes those
+        transposes from the saved BSHD residuals (measured r4:
+        11.25 ms/step of standalone transposes at batch 32). When the
+        single-device flash path is active, the qkv projections emit
+        BHSD directly instead (XLA folds the output permutation into
+        the matmul), rope and the residual tags follow, and no layout
+        churn remains. Ring/Ulysses keep the BSHD contract — they
+        shard the sequence axis and manage their own layouts."""
+        return (self._flash_active()
+                and self.cfg.attention_impl in ("auto", "flash"))
+
+    def _attention(self, q, k, v, layout: str = "bshd"):
         c = self.cfg
         # A window covering the whole (or more of the) sequence is
         # mathematically plain causal; normalize to 0 so the dispatch
@@ -279,13 +301,17 @@ class Transformer:
         # shard_map with sequence parallelism, q.shape[1] is the local
         # S/sp shard — comparing the window against THAT would turn a
         # valid window silently into full causal.
-        S_total = q.shape[1]
+        S_total = q.shape[2] if layout == "bhsd" else q.shape[1]
         if self._inside_pp and c.attention_impl in ("ring", "ulysses"):
             from distributed_training_tpu.runtime import AXIS_SP
             S_total *= self._mesh_axis_sizes().get(AXIS_SP, 1)
         window = (c.attention_window
                   if 0 < c.attention_window < S_total else 0)
         if c.attention_impl in ("ring", "ulysses"):
+            if layout != "bshd":
+                raise ValueError(
+                    "sequence-parallel attention takes BSHD inputs; "
+                    "the BHSD fast path is single-device-flash only")
             if self.mesh is None:
                 raise ValueError(
                     f"attention_impl='{c.attention_impl}' requires "
@@ -370,7 +396,7 @@ class Transformer:
                                      impl=c.attention_impl,
                                      block_q=c.flash_block_q,
                                      block_k=c.flash_block_k,
-                                     window=window)
+                                     window=window, layout=layout)
 
     # -- init --------------------------------------------------------------
 
@@ -486,17 +512,30 @@ class Transformer:
 
         h = _layer_norm(x, layer["ln1"]["scale"], layer["ln1"]["bias"])
         h = name(h, "ln1_out")
-        q = jnp.einsum("bsd,dhk->bshk", h, layer["attn"]["wq"].astype(dt))
-        k = jnp.einsum("bsd,dhk->bshk", h, layer["attn"]["wk"].astype(dt))
-        v = jnp.einsum("bsd,dhk->bshk", h, layer["attn"]["wv"].astype(dt))
+        # BHSD fast path (single-device flash): the qkv projections
+        # emit the kernels' (B, H, S, D) layout directly — XLA folds
+        # the output permutation into the matmul — so the flash
+        # wrapper's per-layer q/k/v/out transposes (and their remat
+        # recompute in backward) vanish. Everything else (ring,
+        # ulysses, naive) keeps the BSHD contract.
+        bhsd = (not return_kv) and self._bhsd_fast()
+        lay = "bhsk" if bhsd else "bshk"
+        q = jnp.einsum(f"bsd,dhk->{lay}", h,
+                       layer["attn"]["wq"].astype(dt))
+        k = jnp.einsum(f"bsd,dhk->{lay}", h,
+                       layer["attn"]["wk"].astype(dt))
+        v = jnp.einsum(f"bsd,dhk->{lay}", h,
+                       layer["attn"]["wv"].astype(dt))
         if c.pos_encoding == "rope":
-            q, k = _rope(q, k, positions)
+            q, k = _rope(q, k, positions,
+                         layout="bhsd" if bhsd else "bshd")
         # Post-rope: saving these skips both the qkv einsums and the
         # rope rotation in backward (rope's VJP needs only cos/sin).
         q, k, v = name(q, "q_rope"), name(k, "k_rope"), name(v, "v_proj")
-        attn = self._attention(q, k, v)
+        attn = self._attention(q, k, v,
+                               layout="bhsd" if bhsd else "bshd")
         attn = name(attn, "attn_out")
-        attn_proj = jnp.einsum("bshk,hkd->bsd", attn,
+        attn_proj = jnp.einsum(f"{lay},hkd->bsd", attn,
                                layer["attn"]["wo"].astype(dt))
         if drop is not None:
             attn_proj = drop(attn_proj,

@@ -67,7 +67,8 @@ def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                           impl: str = "auto",
                           block_q: int | None = None,
                           block_k: int | None = None,
-                          window: int = 0) -> jax.Array:
+                          window: int = 0,
+                          layout: str = "bshd") -> jax.Array:
     """Dispatching attention entrypoint. ``impl``:
 
     - "auto": flash on TPU when shapes are tile-friendly, else naive
@@ -75,7 +76,11 @@ def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 
     ``block_q``/``block_k`` override the flash kernel's tile sizes
     (None → kernel defaults); ignored by the naive path.
+    ``layout="bhsd"``: inputs/outputs are already in the flash
+    kernels' (B, H, S, D) layout — no wrapper transposes (the model's
+    fast path); the naive fallback transposes at this boundary.
     """
+    seq_axis = 2 if layout == "bhsd" else 1
     if impl in ("auto", "flash"):
         from distributed_training_tpu.ops import flash_attention as fa
         # An EXPLICIT tile override that does not divide the sequence
@@ -83,7 +88,7 @@ def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         # rows measure the wrong kernel under the override's label
         # (ADVICE r3; mirrors ring_attention's raise-don't-ignore).
         if impl == "auto" and (block_q or block_k):
-            sq, sk = q.shape[1], k.shape[1]
+            sq, sk = q.shape[seq_axis], k.shape[seq_axis]
             if (block_q and sq % min(block_q, sq)) or (
                     block_k and sk % min(block_k, sk)):
                 raise ValueError(
@@ -92,15 +97,21 @@ def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     f"(Sq={sq}, Sk={sk}); fix the override or pass "
                     "impl='naive' explicitly")
         if fa.supported(q, k, v, block_q=block_q or 0,
-                        block_k=block_k or 0) or impl == "flash":
+                        block_k=block_k or 0,
+                        layout=layout) or impl == "flash":
             kw = {}
             if block_q:
                 kw["block_q"] = block_q
             if block_k:
                 kw["block_k"] = block_k
             return fa.flash_attention(q, k, v, causal=causal,
-                                      window=window, **kw)
+                                      window=window, layout=layout,
+                                      **kw)
         impl = "naive"
     if impl == "naive":
+        if layout == "bhsd":
+            t = lambda x: jnp.transpose(x, (0, 2, 1, 3))  # noqa: E731
+            return t(_naive_attention(t(q), t(k), t(v), causal,
+                                      window=window))
         return _naive_attention(q, k, v, causal, window=window)
     raise ValueError(f"unknown attention impl '{impl}'")

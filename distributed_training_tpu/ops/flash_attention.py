@@ -128,7 +128,8 @@ def _platform_is_tpu() -> bool:
 
 
 def supported(q: jax.Array, k: jax.Array, v: jax.Array,
-              block_q: int = 0, block_k: int = 0) -> bool:
+              block_q: int = 0, block_k: int = 0,
+              layout: str = "bshd") -> bool:
     """Should auto-dispatch route here? (Else: naive fallback.)
 
     Conservative by design: off-TPU the interpreter would be orders of
@@ -137,23 +138,25 @@ def supported(q: jax.Array, k: jax.Array, v: jax.Array,
     ``block_q``/``block_k`` are the caller's tile overrides (0 → kernel
     defaults) — divisibility is checked against the EFFECTIVE tiles so
     a non-dividing override falls back instead of crashing the trace.
+    ``layout``: where the sequence/head axes live ("bshd" or "bhsd").
     """
     del v
+    s_ax, h_ax = (2, 1) if layout == "bhsd" else (1, 2)
     if not _platform_is_tpu():
         return False
     if q.dtype not in (jnp.float32, jnp.bfloat16):
         return False
-    if q.shape[1] != k.shape[1]:
+    if q.shape[s_ax] != k.shape[s_ax]:
         return False
-    if q.shape[1] < 128:
+    if q.shape[s_ax] < 128:
         return False
-    bq, bk = _resolve_blocks(block_q, block_k, q.shape[1], k.shape[1],
-                             q.shape[3])
-    if not bq or not bk or q.shape[1] % bq or k.shape[1] % bk:
+    bq, bk = _resolve_blocks(block_q, block_k, q.shape[s_ax],
+                             k.shape[s_ax], q.shape[3])
+    if not bq or not bk or q.shape[s_ax] % bq or k.shape[s_ax] % bk:
         return False
     if q.shape[3] > 256:
         return False
-    if q.shape[2] % k.shape[2]:
+    if q.shape[h_ax] % k.shape[h_ax]:
         return False
     return True
 
@@ -662,32 +665,43 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     causal: bool = True,
                     block_q: int = 0,
                     block_k: int = 0,
-                    window: int = 0) -> jax.Array:
+                    window: int = 0,
+                    layout: str = "bshd") -> jax.Array:
     """Flash attention over (B, S, H, D) inputs (GQA allowed).
 
     ``block_q``/``block_k`` = 0 take the measured seq-aware defaults
     (``default_blocks``); explicit values override, seq-clamped.
     ``window`` > 0 = sliding-window (Mistral-style) attention: query i
     attends keys in [i − window + 1, i]. Requires ``causal``; k-blocks
-    outside the band are skipped, so cost is O(S·window)."""
+    outside the band are skipped, so cost is O(S·window).
+    ``layout="bhsd"``: inputs/output already in the kernels' native
+    (B, H, S, D) — skips the wrapper transposes entirely (the model's
+    fast path emits this layout straight from its qkv einsums)."""
     if window < 0:
         raise ValueError(f"window must be >= 0, got {window}")
     if window and not causal:
         raise ValueError("window > 0 requires causal=True")
-    B, S, H, D = q.shape
-    Hkv = k.shape[2]
-    if S != k.shape[1] and causal:
+    if layout not in ("bshd", "bhsd"):
+        raise ValueError(f"unknown layout '{layout}'")
+    native = layout == "bhsd"
+    s_ax, h_ax = (2, 1) if native else (1, 2)
+    S, D = q.shape[s_ax], q.shape[3]
+    H, Hkv = q.shape[h_ax], k.shape[h_ax]
+    Sk = k.shape[s_ax]
+    if S != Sk and causal:
         raise ValueError(
             f"flash kernel's causal mask requires Sq == Sk, got "
-            f"{S} vs {k.shape[1]}; use impl='naive'")
+            f"{S} vs {Sk}; use impl='naive'")
     if H % Hkv:
         raise ValueError(
             f"n_heads {H} not divisible by n_kv_heads {Hkv}")
-    bq, bk = _resolve_blocks(block_q, block_k, S, k.shape[1], D)
-    if not bq or not bk or S % bq or k.shape[1] % bk:
+    bq, bk = _resolve_blocks(block_q, block_k, S, Sk, D)
+    if not bq or not bk or S % bq or Sk % bk:
         raise ValueError(
-            f"sequence lengths ({S}, {k.shape[1]}) must be divisible by "
+            f"sequence lengths ({S}, {Sk}) must be divisible by "
             f"block sizes ({bq}, {bk}); pad or use impl='naive'")
+    if native:
+        return _flash_bhsd(q, k, v, causal, bq, bk, window)
     qt = jnp.transpose(q, (0, 2, 1, 3))
     kt = jnp.transpose(k, (0, 2, 1, 3))
     vt = jnp.transpose(v, (0, 2, 1, 3))
