@@ -1,0 +1,176 @@
+#!/usr/bin/env python
+"""Offline audit of the collectives XLA compiles into a sharded step.
+
+The sharding design (parallel/strategy.py, runtime.py mesh) never
+spells out its communication — XLA's SPMD partitioner derives the
+collectives from the sharding annotations. That is the point of the
+design, but it means a layout regression shows up only as silent
+extra traffic: ZeRO-1 degenerating to replicated moments, a bad batch
+spec inserting an all-to-all, FSDP all-gathers landing in the wrong
+pass. This tool compiles the EXACT jitted train step on a virtual
+device mesh (CPU, no chip needed) and reports every collective in the
+optimized HLO — kind, element type, shape, estimated bytes moved per
+step — so the communication contract is a testable artifact.
+
+    python benchmarks/audit_collectives.py --devices 8 --strategy ddp
+    python benchmarks/audit_collectives.py --devices 8 --strategy zero1
+    python benchmarks/audit_collectives.py --devices 8 --mesh tp=2,sp=2,fsdp=2
+
+Prints a human table to stderr and one JSON summary line to stdout.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+from collections import defaultdict
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+
+# Virtual device count must be set before jax initializes.
+_N = None
+for _i, _a in enumerate(sys.argv):
+    if _a == "--devices" and _i + 1 < len(sys.argv):
+        _N = sys.argv[_i + 1]
+    elif _a.startswith("--devices="):
+        _N = _a.split("=", 1)[1]
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        f"{_flags} --xla_force_host_platform_device_count={_N or 8}"
+    ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+_DTYPE_BYTES = {"f64": 8, "f32": 4, "bf16": 2, "f16": 2, "s32": 4,
+                "u32": 4, "s16": 2, "u16": 2, "s8": 1, "u8": 1,
+                "pred": 1, "s64": 8, "u64": 8}
+
+_COLLECTIVES = ("all-reduce", "all-gather", "reduce-scatter",
+                "collective-permute", "all-to-all")
+
+# One optimized-HLO instruction: "%name = TYPE op(...)" where TYPE is
+# either a single "dt[shape]{layout}" or a tuple "(dt[s], dt[s], ...)"
+# — tuple results are how XLA emits FUSED collectives (e.g. one
+# all-reduce syncing every gradient leaf), so a single-type parser
+# silently undercounts exactly the most important instruction.
+# Async HLO (the TPU compiler's usual form) splits a collective into a
+# '-start'/'-done' pair; counting both would double the count and
+# ~triple the bytes (the start's result tuple aliases operand AND
+# result buffers). Count sync base forms and async '-done' lines —
+# the done's result type is the collective's true output — and let
+# '-start' lines fall through unmatched (the base-form alternative
+# cannot match them: the char after the op name is '-', not '(').
+_OP_LINE = re.compile(
+    r"=\s+(.*?)\s+"
+    r"(all-reduce|all-gather|reduce-scatter|collective-permute|"
+    r"all-to-all)(?:-done)?\(")
+_TYPE = re.compile(r"(\w+)\[([\d,]*)\]")
+
+
+def _bytes_of(dtype: str, shape: str) -> int:
+    n = 1
+    for d in filter(None, shape.split(",")):
+        n *= int(d)
+    return n * _DTYPE_BYTES.get(dtype, 4)
+
+
+def audit_hlo_text(text: str) -> dict:
+    """Parse optimized HLO text → per-collective counts and bytes."""
+    rows = []
+    for m in _OP_LINE.finditer(text):
+        types, kind = m.group(1), m.group(2)
+        parts = _TYPE.findall(types)
+        if not parts:
+            continue
+        total = sum(_bytes_of(dt, sh) for dt, sh in parts)
+        big_dt, big_sh = max(
+            parts, key=lambda p: _bytes_of(p[0], p[1]))
+        rows.append({"kind": kind, "dtype": big_dt,
+                     "shape": big_sh or "scalar",
+                     "tuple_arity": len(parts),
+                     "bytes": total})
+    by_kind: dict = defaultdict(lambda: {"count": 0, "bytes": 0})
+    for r in rows:
+        by_kind[r["kind"]]["count"] += 1
+        by_kind[r["kind"]]["bytes"] += r["bytes"]
+    return {
+        "total_collectives": len(rows),
+        "by_kind": dict(by_kind),
+        "largest": sorted(rows, key=lambda r: -r["bytes"])[:10],
+    }
+
+
+def compile_step_hlo(n_devices: int, strategy: str,
+                     mesh_axes: dict | None = None,
+                     model_kwargs: dict | None = None) -> str:
+    """Build the real Trainer on a virtual mesh and return the
+    compiled (SPMD-partitioned) HLO of its jitted train step."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from distributed_training_tpu.config import Config
+    from distributed_training_tpu.data import (ShardedDataLoader,
+                                               SyntheticLMDataset)
+    from distributed_training_tpu.models import build_model
+    from distributed_training_tpu.runtime import fake_cpu_runtime
+    from distributed_training_tpu.train.trainer import Trainer
+
+    cfg = Config()
+    cfg.train.parallel_strategy = strategy
+    cfg.train.batch_size = 2 * n_devices
+    cfg.train.log_every = 0
+    cfg.train.min_shard_elems = 1
+    cfg.train.dtype = "float32"
+    rt = fake_cpu_runtime(n_devices, **(mesh_axes or {}))
+    mk = dict(vocab_size=256, d_model=64, n_layers=2, n_heads=4,
+              max_seq_len=64, dtype="float32")
+    mk.update(model_kwargs or {})
+    model = build_model("transformer", **mk)
+    ds = SyntheticLMDataset(size=max(64, cfg.train.batch_size),
+                            seq_len=32, vocab_size=256, seed=0)
+    loader = ShardedDataLoader(ds, rt, batch_size=cfg.train.batch_size,
+                               shuffle=False)
+    trainer = Trainer(cfg, rt, model, loader)
+    batch = next(iter(loader.epoch(0)))
+    import jax.numpy as jnp
+
+    lowered = trainer._step_fn.lower(trainer.state, batch,
+                                     jnp.zeros((2,), jnp.uint32))
+    return lowered.compile().as_text()
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--devices", type=int, default=8)
+    ap.add_argument("--strategy", default="ddp")
+    ap.add_argument("--mesh", default="",
+                    help="axis sizes, e.g. tp=2,sp=2,fsdp=2 "
+                         "(remainder goes to dp)")
+    ap.add_argument("--model-kwargs", default="{}")
+    args = ap.parse_args()
+    mesh_axes = {}
+    if args.mesh:
+        for part in args.mesh.split(","):
+            k, v = part.split("=")
+            mesh_axes[k.strip()] = int(v)
+    text = compile_step_hlo(args.devices, args.strategy, mesh_axes,
+                            json.loads(args.model_kwargs))
+    rep = audit_hlo_text(text)
+    rep["devices"] = args.devices
+    rep["strategy"] = args.strategy
+    rep["mesh"] = mesh_axes
+    for kind, row in sorted(rep["by_kind"].items(),
+                            key=lambda kv: -kv[1]["bytes"]):
+        print(f"{kind:20s} x{row['count']:3d}  "
+              f"{row['bytes'] / 1e6:9.3f} MB", file=sys.stderr)
+    print(json.dumps(rep))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

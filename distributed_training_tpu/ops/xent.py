@@ -7,7 +7,7 @@ and caps the batch size far below what the rest of the model needs to
 saturate the MXU.
 
 This op computes per-token ``nll = logsumexp(x @ head) - (x @ head)[t]``
-in row chunks under ``lax.scan`` and registers a custom VJP that
+in sequence chunks under ``lax.scan`` and registers a custom VJP that
 *recomputes* each chunk's logits in the backward pass instead of saving
 them:
 
@@ -17,6 +17,18 @@ them:
   two head matmuls (dx, dhead) directly, fp32 accumulation on the MXU;
 - extra cost is one logits recompute (+2·B·S·D·V FLOPs, ~3% of a 125M
   step) traded for gigabytes of HBM — the classic TPU trade.
+
+Sharding contract (found by benchmarks/audit_collectives.py): the scan
+chunks along the SEQUENCE axis and keeps the batch axis whole, all ops
+rank-3. An earlier version flattened ``(B, S) → rows`` and chunked the
+rows — merging the dp/fsdp-sharded batch dim into the row dim, which
+made the SPMD partitioner all-gather the hidden states (and tokens)
+across data parallel ranks every step: at GPT-2 125M scale, hundreds
+of MB of ICI traffic per step that the dense head never paid. With
+batch-axis-preserving chunks the partitioned loss is computed entirely
+on local shards and the only collectives in a DDP step are the
+gradient all-reduces (pinned by tests/test_benchmarks.py::
+test_ddp_step_collectives_are_grad_allreduce_only).
 
 No reference counterpart (its models are Linear stubs and its loss is
 the degenerate ``F.cross_entropy`` of src/distributed_trainer.py:163;
@@ -33,92 +45,101 @@ import jax.numpy as jnp
 DEFAULT_CHUNK_ROWS = 2048
 
 
-def _pad_rows(n: int, chunk: int) -> int:
-    return (-n) % chunk
+def _seq_chunk(batch: int, seq: int, chunk_rows: int) -> int:
+    """Sequence positions per scan step so that ``B * sc`` ≈ the
+    requested row budget (the only (rows, V) fp32 buffer alive)."""
+    return max(1, min(seq, chunk_rows // max(batch, 1)))
 
 
-def _chunked(x2: jax.Array, t1: jax.Array, chunk: int):
-    """(N, D) rows + (N,) targets → (C, chunk, D) / (C, chunk), padding
-    with target −1 (masked out downstream)."""
-    n = x2.shape[0]
-    pad = _pad_rows(n, chunk)
+def _pad_seq(x: jax.Array, t: jax.Array, sc: int):
+    """Pad the sequence axis to a multiple of ``sc``; padded targets
+    are −1 (masked out downstream)."""
+    B, S = t.shape
+    pad = (-S) % sc
     if pad:
-        x2 = jnp.concatenate(
-            [x2, jnp.zeros((pad, x2.shape[1]), x2.dtype)], axis=0)
-        t1 = jnp.concatenate(
-            [t1, jnp.full((pad,), -1, t1.dtype)], axis=0)
-    c = x2.shape[0] // chunk
-    return x2.reshape(c, chunk, -1), t1.reshape(c, chunk)
+        x = jnp.concatenate(
+            [x, jnp.zeros((B, pad, x.shape[2]), x.dtype)], axis=1)
+        t = jnp.concatenate(
+            [t, jnp.full((B, pad), -1, t.dtype)], axis=1)
+    return x, t
+
+
+def _to_chunks(a: jax.Array, sc: int) -> jax.Array:
+    """(B, S, ...) → (C, B, sc, ...): split the (replicated-sharding)
+    sequence axis and scan over it; the batch axis stays whole so a
+    dp/fsdp-sharded batch never crosses a reshape boundary."""
+    B, S = a.shape[0], a.shape[1]
+    rest = a.shape[2:]
+    return jnp.moveaxis(a.reshape(B, S // sc, sc, *rest), 1, 0)
+
+
+def _from_chunks(a: jax.Array) -> jax.Array:
+    """(C, B, sc, ...) → (B, C·sc, ...)."""
+    C, B, sc = a.shape[0], a.shape[1], a.shape[2]
+    return jnp.moveaxis(a, 0, 1).reshape(B, C * sc, *a.shape[3:])
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
-def _lm_xent_rows(x2, head, t1, chunk):
-    nll, _ = _fwd_scan(x2, head, t1, chunk)
+def _lm_xent_bsd(x, head, t, sc):
+    nll, _ = _fwd_scan(x, head, t, sc)
     return nll
 
 
-def _fwd_scan(x2, head, t1, chunk):
-    n = x2.shape[0]
-    xc, tc = _chunked(x2, t1, chunk)
+def _fwd_scan(x, head, t, sc):
+    xc, tc = _to_chunks(x, sc), _to_chunks(t, sc)
 
     def body(_, inp):
-        xb, tb = inp                        # (chunk, D), (chunk,)
+        xb, tb = inp                        # (B, sc, D), (B, sc)
         logits = jax.lax.dot_general(
-            xb, head, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)      # (chunk, V) fp32
+            xb, head, (((2,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)      # (B, sc, V) fp32
         m = jnp.max(logits, axis=-1)
-        lse = m + jnp.log(jnp.sum(jnp.exp(logits - m[:, None]),
-                                  axis=-1))
+        lse = m + jnp.log(jnp.sum(
+            jnp.exp(logits - m[..., None]), axis=-1))
         tgt = jnp.take_along_axis(
-            logits, jnp.maximum(tb, 0)[:, None], axis=-1)[:, 0]
+            logits, jnp.maximum(tb, 0)[..., None], axis=-1)[..., 0]
         nll = jnp.where(tb >= 0, lse - tgt, 0.0)
         return 0, (nll, lse)
 
     _, (nll, lse) = jax.lax.scan(body, 0, (xc, tc))
-    return nll.reshape(-1)[:n], lse.reshape(-1)
+    return _from_chunks(nll), lse            # (B, S_p), (C, B, sc)
 
 
-def _lm_xent_fwd(x2, head, t1, chunk):
-    nll, lse = _fwd_scan(x2, head, t1, chunk)
-    return nll, (x2, head, t1, lse)
+def _lm_xent_fwd(x, head, t, sc):
+    nll, lse = _fwd_scan(x, head, t, sc)
+    return nll, (x, head, t, lse)
 
 
-def _lm_xent_bwd(chunk, res, dnll):
-    x2, head, t1, lse = res
-    n = x2.shape[0]
-    xc, tc = _chunked(x2, t1, chunk)
-    pad = _pad_rows(n, chunk)
-    dnll_p = (jnp.concatenate([dnll, jnp.zeros((pad,), dnll.dtype)])
-              if pad else dnll)
-    dc = dnll_p.reshape(-1, chunk)
-    lc = lse.reshape(-1, chunk)
+def _lm_xent_bwd(sc, res, dnll):
+    x, head, t, lse = res
 
     def body(dhead_acc, inp):
-        xb, tb, db, lb = inp
+        xb, tb, db, lb = inp                 # (B, sc, *), lb (B, sc)
         logits = jax.lax.dot_general(
-            xb, head, (((1,), (0,)), ((), ())),
+            xb, head, (((2,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)      # recomputed
-        p = jnp.exp(logits - lb[:, None])            # softmax, fp32
+        p = jnp.exp(logits - lb[..., None])          # softmax, fp32
         valid = (tb >= 0)
         onehot = jax.nn.one_hot(jnp.maximum(tb, 0), head.shape[1],
                                 dtype=jnp.float32)
         g = jnp.where(valid, db, 0.0).astype(jnp.float32)
-        dlogits = ((p - onehot) * g[:, None]).astype(x2.dtype)
+        dlogits = ((p - onehot) * g[..., None]).astype(x.dtype)
         dxb = jax.lax.dot_general(
-            dlogits, head, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32).astype(x2.dtype)
+            dlogits, head, (((2,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32).astype(x.dtype)
         dhead_acc = dhead_acc + jax.lax.dot_general(
-            xb, dlogits, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+            xb, dlogits, (((0, 1), (0, 1)), ((), ())),
+            preferred_element_type=jnp.float32)      # (D, V)
         return dhead_acc, dxb
 
     dhead, dx = jax.lax.scan(
-        body, jnp.zeros(head.shape, jnp.float32), (xc, tc, dc, lc))
-    dx = dx.reshape(-1, x2.shape[1])[:n]
-    return dx, dhead.astype(head.dtype), None
+        body, jnp.zeros(head.shape, jnp.float32),
+        (_to_chunks(x, sc), _to_chunks(t, sc),
+         _to_chunks(dnll, sc), lse))
+    return (_from_chunks(dx), dhead.astype(head.dtype), None)
 
 
-_lm_xent_rows.defvjp(_lm_xent_fwd, _lm_xent_bwd)
+_lm_xent_bsd.defvjp(_lm_xent_fwd, _lm_xent_bwd)
 
 
 def lm_cross_entropy(x: jax.Array, head: jax.Array, targets: jax.Array,
@@ -131,12 +152,15 @@ def lm_cross_entropy(x: jax.Array, head: jax.Array, targets: jax.Array,
       head: unembedding ``(D, V)``.
       targets: int token ids ``(B, S)``; negative ids are masked (their
         nll and gradient contribution are exactly zero).
-      chunk_rows: rows per scan step — the only (rows, V) fp32 buffer
-        ever alive.
+      chunk_rows: approximate rows per scan step — the per-step
+        ``(B, sc, V)`` fp32 logits buffer holds ``B·sc ≈ chunk_rows``
+        rows (sequence-chunked; the batch axis is never split, see the
+        sharding contract in the module docstring).
 
     Returns per-token nll ``(B, S)`` fp32.
     """
     b, s, d = x.shape
-    nll = _lm_xent_rows(x.reshape(b * s, d), head,
-                        targets.reshape(b * s), chunk_rows)
-    return nll.reshape(b, s)
+    sc = _seq_chunk(b, s, chunk_rows)
+    xp, tp = _pad_seq(x, targets, sc)
+    nll = _lm_xent_bsd(xp, head, tp, sc)
+    return nll[:, :s]

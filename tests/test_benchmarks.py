@@ -481,3 +481,56 @@ def test_profile_step_merges_duplicate_model_kwargs(capsys):
         '"vocab_size": 256}'])
     assert rc == 0
     assert "step mfu" in capsys.readouterr().out
+
+
+def test_ddp_step_collectives_are_grad_allreduce_only():
+    """Communication contract (benchmarks/audit_collectives.py): a DDP
+    train step's only collectives are gradient all-reduces (plus the
+    scalar agreed-stop reduce) — no all-gathers, no all-to-alls.
+
+    Regression pin for a real bug this audit found: the fused xent
+    head used to flatten (B, S) into row chunks, merging the
+    dp-sharded batch axis into the row axis, and the SPMD partitioner
+    responded by ALL-GATHERING the hidden states and tokens across
+    data-parallel ranks every step (5 gathers, activation-sized — at
+    GPT-2 scale hundreds of MB of interconnect traffic per step that
+    the dense head never paid). Sequence-axis chunking keeps the loss
+    shard-local."""
+    import audit_collectives as ac
+
+    text = ac.compile_step_hlo(8, "ddp")
+    rep = ac.audit_hlo_text(text)
+    assert rep["by_kind"].get("all-gather", {"count": 0})["count"] == 0, rep
+    assert rep["by_kind"].get("all-to-all", {"count": 0})["count"] == 0, rep
+    assert rep["by_kind"]["all-reduce"]["count"] >= 1
+    # Gradient sync must move roughly the full parameter set once
+    # (tiny model ≈ 339 KB of f32 grads), not activation-scale bytes.
+    assert rep["by_kind"]["all-reduce"]["bytes"] < 1_000_000
+
+    # FSDP on a real fsdp mesh must gather params (sanity that the
+    # audit sees strategy differences, not that it pins FSDP's exact
+    # schedule — partitioner choices at toy scale are heuristic).
+    text = ac.compile_step_hlo(8, "fsdp", {"fsdp": 8})
+    rep = ac.audit_hlo_text(text)
+    assert rep["by_kind"].get("all-gather", {"count": 0})["count"] > 0
+
+
+def test_audit_collectives_async_hlo_counted_once():
+    """TPU HLO emits collectives as '-start'/'-done' pairs; the audit
+    must count each collective once with the done's (true result)
+    bytes — the start's tuple aliases operand+result and would
+    roughly triple the byte estimate."""
+    import audit_collectives as ac
+
+    text = """
+      %ar0 = (f32[100]{0}, f32[100]{0}) all-reduce-start(%x)
+      %ar1 = f32[100]{0} all-reduce-done(%ar0)
+      %ag = f32[4,8]{1,0} all-gather(%y), dimensions={0}
+      %cp0 = (bf16[2,8]{1,0}, bf16[2,8]{1,0}) collective-permute-start(%z)
+      %cp1 = bf16[2,8]{1,0} collective-permute-done(%cp0)
+    """
+    rep = ac.audit_hlo_text(text)
+    assert rep["by_kind"]["all-reduce"] == {"count": 1, "bytes": 400}
+    assert rep["by_kind"]["all-gather"] == {"count": 1, "bytes": 128}
+    assert rep["by_kind"]["collective-permute"] == {
+        "count": 1, "bytes": 32}
