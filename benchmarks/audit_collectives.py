@@ -101,6 +101,9 @@ def audit_hlo_text(text: str) -> dict:
         "total_collectives": len(rows),
         "by_kind": dict(by_kind),
         "largest": sorted(rows, key=lambda r: -r["bytes"])[:10],
+        # Full row list: contract tests must scan EVERY collective —
+        # a pathological row ranked 11th would hide from "largest".
+        "rows": rows,
     }
 
 

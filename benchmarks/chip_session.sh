@@ -43,6 +43,11 @@ phase() {  # phase NAME TIMEOUT_S CMD...
 }
 
 phase headline 1500 python bench.py
+# Kernel A/B on identical config: the fused single-sweep flash
+# backward (default) vs the split FlashAttention-2 pair — the fused
+# kernel landed chip-unmeasured during a 4h+ wedge.
+phase splitbwd 1200 env DTT_FLASH_SPLIT_BWD=1 \
+  python benchmarks/tune_headline.py --points '[[32, {}]]'
 phase trace32 1200 python benchmarks/profile_step.py --batch 32 \
   --model-kwargs '{"remat": true, "remat_policy": "mlp"}' \
   --trace "$OUT/trace_b32"

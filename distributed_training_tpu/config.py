@@ -54,6 +54,15 @@ class TrainConfig:
     # device for the update); this frees between-step residency, at
     # the cost of two opt-state transfers per step.
     offload_opt_state: bool = False
+    # FSDP compute contract: constrain weights replicated at their
+    # cast-to-compute sites so XLA all-gathers each weight for its
+    # matmuls (layer-by-layer inside the scan, bf16, transient)
+    # instead of all-reducing partial-product ACTIVATIONS — measured
+    # via benchmarks/audit_collectives.py, the partitioner otherwise
+    # chooses activation-shaped collectives that dwarf FSDP's param
+    # traffic. Applies only when parallel_strategy == "fsdp" and the
+    # model supports the binding.
+    fsdp_gather_for_compute: bool = True
     # Durable metrics stream: coordinator appends every recorded entry
     # (loss, samples/sec/chip, mfu, val_loss) as one JSON line. Empty →
     # disabled; the CLI defaults it to <run_dir>/metrics.jsonl.

@@ -242,6 +242,7 @@ class Transformer:
         # is already manual there — _attention must not open a nested
         # shard_map).
         self._inside_pp = False
+        self._compute_replicate = None  # bind_gather_for_compute
 
     def bind_mesh(self, mesh) -> None:
         """Give the model the device mesh (needed only for the
@@ -249,6 +250,35 @@ class Transformer:
         ``'ulysses'``: their shard_maps over the ``sp`` axis are
         constructed against a concrete mesh)."""
         self.mesh = mesh
+
+    def bind_gather_for_compute(self, sharding) -> None:
+        """FSDP compute contract: constrain weights to ``sharding``
+        (replicated) at their cast-to-compute-dtype sites, so XLA
+        ALL-GATHERS each weight for its matmuls instead of running
+        partial matmuls on weight shards and ALL-REDUCING the
+        activations. Found by benchmarks/audit_collectives.py: with
+        fsdp-sharded params and no constraint, the partitioner's cost
+        model chose activation-shaped all-reduces — (B, S, V) logits,
+        (B, S, H, D) qkv — which dwarf the parameter traffic FSDP is
+        supposed to pay. The constraint sits INSIDE the layer scan on
+        the per-layer slice (gathers are layer-by-layer, bf16, and
+        transient) and on the embedding table / unembedding head at
+        their single use sites."""
+        self._compute_replicate = sharding
+
+    def _w(self, p: jax.Array, dt) -> jax.Array:
+        """Cast a weight to compute dtype; under an FSDP gather-for-
+        compute binding, also constrain it replicated (cast FIRST so
+        the gather moves bf16, not fp32 masters). Inside the
+        pipeline's shard_map every mesh axis is manual — a named
+        sharding constraint would be rejected at trace time — so the
+        constraint is skipped there (stage params arrive already
+        gathered per-stage by the pipeline's own specs)."""
+        w = p.astype(dt)
+        if self._compute_replicate is not None and not self._inside_pp:
+            w = jax.lax.with_sharding_constraint(
+                w, self._compute_replicate)
+        return w
 
     def _mesh_axis_sizes(self) -> dict:
         if self.mesh is None:
@@ -521,11 +551,11 @@ class Transformer:
         bhsd = (not return_kv) and self._bhsd_fast()
         lay = "bhsk" if bhsd else "bshk"
         q = jnp.einsum(f"bsd,dhk->{lay}", h,
-                       layer["attn"]["wq"].astype(dt))
+                       self._w(layer["attn"]["wq"], dt))
         k = jnp.einsum(f"bsd,dhk->{lay}", h,
-                       layer["attn"]["wk"].astype(dt))
+                       self._w(layer["attn"]["wk"], dt))
         v = jnp.einsum(f"bsd,dhk->{lay}", h,
-                       layer["attn"]["wv"].astype(dt))
+                       self._w(layer["attn"]["wv"], dt))
         if c.pos_encoding == "rope":
             q, k = _rope(q, k, positions,
                          layout="bhsd" if bhsd else "bshd")
@@ -536,7 +566,7 @@ class Transformer:
                                layout="bhsd" if bhsd else "bshd")
         attn = name(attn, "attn_out")
         attn_proj = jnp.einsum(f"{lay},hkd->bsd", attn,
-                               layer["attn"]["wo"].astype(dt))
+                               self._w(layer["attn"]["wo"], dt))
         if drop is not None:
             attn_proj = drop(attn_proj,
                              rng=jax.random.fold_in(dropout_rng, 0))
@@ -545,18 +575,18 @@ class Transformer:
         h = _layer_norm(x, layer["ln2"]["scale"], layer["ln2"]["bias"])
         h = name(h, "ln2_out")
         if c.moe_num_experts > 0:
-            mlp_out, aux = _moe_mlp(h, layer["mlp"], c)
+            mlp_out, aux = _moe_mlp(h, layer["mlp"], c, w=self._w)
         else:
             m = layer["mlp"]
             # The two (B, S, 4D) tensors here are deliberately
             # UN-named: under the "mlp" policy's allow-list they are
             # the only recompute (wi-matmul + gelu in backward).
             u = jnp.einsum(
-                "bsd,df->bsf", h, m["wi"].astype(dt)
+                "bsd,df->bsf", h, self._w(m["wi"], dt)
             ) + m["bi"].astype(dt)
             u = jax.nn.gelu(u)
             mlp_out = jnp.einsum(
-                "bsf,fd->bsd", u, m["wo"].astype(dt)
+                "bsf,fd->bsd", u, self._w(m["wo"], dt)
             ) + m["bo"].astype(dt)
             aux = jnp.zeros((), jnp.float32)
         if drop is not None:
@@ -577,10 +607,14 @@ class Transformer:
         dt = jnp.dtype(c.dtype)
         B, S = tokens.shape
         dropping = bool(train and c.dropout > 0.0 and rng is not None)
-        x = params["tok_embed"][tokens].astype(dt)
+        # Gather-for-compute (when bound): constrain the TABLE before
+        # indexing, so a vocab-sharded embedding is all-gathered once
+        # (param-scale, bf16) instead of the lookup emitting an
+        # activation-scale (B, S, D) all-reduce of one-hot partials.
+        x = self._w(params["tok_embed"], dt)[tokens]
         positions = jnp.arange(S)
         if c.pos_encoding == "learned":
-            x = x + params["pos_embed"][:S].astype(dt)
+            x = x + self._w(params["pos_embed"], dt)[:S]
         if dropping:  # GPT-2's embd_pdrop (fold_in needs non-negative)
             x = _dropout(x, rng=jax.random.fold_in(rng, 1_000_003),
                          rate=c.dropout)
@@ -758,7 +792,7 @@ class Transformer:
         an ``rng`` is given; eval/inference is deterministic."""
         x, aux = self._trunk(params, tokens, rng=rng, train=train)
         logits = jnp.einsum("bsd,dv->bsv", x,
-                            self._head(params).astype(x.dtype))
+                            self._w(self._head(params), x.dtype))
         return logits.astype(jnp.float32), aux
 
     # -- loss --------------------------------------------------------------
@@ -769,7 +803,7 @@ class Transformer:
         if self.cfg.loss_impl == "fused":
             from distributed_training_tpu.ops.xent import lm_cross_entropy
             x, aux = self._trunk(params, inputs, rng=rng, train=train)
-            nll = lm_cross_entropy(x, self._head(params).astype(x.dtype),
+            nll = lm_cross_entropy(x, self._w(self._head(params), x.dtype),
                                    targets)
             # Negative target ids are masked pad positions (zero nll &
             # gradient inside the op) — average over real tokens only.
@@ -1039,8 +1073,17 @@ class Transformer:
         return fn(params, prompt, rng)
 
 
+def _cast_w(p, dt):
+    """Default weight consumer for the MoE helpers: plain cast. The
+    train path passes ``Transformer._w`` instead so expert/router
+    weights get the FSDP gather-for-compute constraint (without it,
+    fsdp-sharded expert weights re-trigger the activation-all-reduce
+    pathology benchmarks/audit_collectives.py exposed)."""
+    return p.astype(dt)
+
+
 def _moe_router(h: jax.Array, mlp: dict, c: TransformerConfig,
-                valid: jax.Array | None = None):
+                valid: jax.Array | None = None, w=_cast_w):
     """Shared routing head: normalized top-k weights/indices + the
     Switch/GShard load-balancing aux (E · Σ_e mean_prob_e · mean_frac_e),
     computed pre-capacity so the balance signal sees dropped tokens.
@@ -1050,7 +1093,7 @@ def _moe_router(h: jax.Array, mlp: dict, c: TransformerConfig,
     no capacity slots) and from the aux statistics."""
     dt = h.dtype
     E, k = c.moe_num_experts, c.moe_top_k
-    gates = jnp.einsum("...d,de->...e", h, mlp["router"].astype(dt))
+    gates = jnp.einsum("...d,de->...e", h, w(mlp["router"], dt))
     probs = jax.nn.softmax(gates.astype(jnp.float32), axis=-1)
     topv, topi = jax.lax.top_k(probs, k)              # (..., k)
     topv = topv / jnp.sum(topv, axis=-1, keepdims=True)
@@ -1069,18 +1112,18 @@ def _moe_router(h: jax.Array, mlp: dict, c: TransformerConfig,
     return topv, onehot, aux
 
 
-def _moe_mlp_dense(h, mlp, c: TransformerConfig):
+def _moe_mlp_dense(h, mlp, c: TransformerConfig, w=_cast_w):
     """Reference dispatch: every expert computes every token, masked
     combine. Exact but O(E) FLOPs — numerics baseline for the routed
     path and the sane choice for very small E."""
     dt = h.dtype
-    topv, onehot, aux = _moe_router(h, mlp, c)
+    topv, onehot, aux = _moe_router(h, mlp, c, w=w)
     combine = jnp.einsum("bsk,bske->bse", topv, onehot)  # (B,S,E)
-    up = jnp.einsum("bsd,edf->besf", h, mlp["wi"].astype(dt))
+    up = jnp.einsum("bsd,edf->besf", h, w(mlp["wi"], dt))
     # Deliberately un-named: under remat_policy="mlp"'s allow-list the
     # (B, E, S, F) expert hiddens (E× the dense class) are recomputed.
     up = jax.nn.gelu(up)
-    down = jnp.einsum("besf,efd->besd", up, mlp["wo"].astype(dt))
+    down = jnp.einsum("besf,efd->besd", up, w(mlp["wo"], dt))
     out = jnp.einsum("besd,bse->bsd", down, combine.astype(dt))
     return out, aux
 
@@ -1095,7 +1138,7 @@ def _moe_group_size(T: int, cap: int) -> tuple[int, int]:
     return g, -(-T // g) * g
 
 
-def _moe_mlp_routed(h, mlp, c: TransformerConfig):
+def _moe_mlp_routed(h, mlp, c: TransformerConfig, w=_cast_w):
     """Capacity-bounded top-k dispatch (GShard-style, TPU-first).
 
     Tokens are flattened, split into groups of ≤ ``moe_group_size``, and
@@ -1127,7 +1170,7 @@ def _moe_mlp_routed(h, mlp, c: TransformerConfig):
             [x, jnp.zeros((T_pad - T, D), x.dtype)], axis=0)
         valid = (jnp.arange(T_pad) < T).reshape(G, g)
     x = x.reshape(G, g, D)
-    topv, onehot, aux = _moe_router(x, mlp, c, valid=valid)
+    topv, onehot, aux = _moe_router(x, mlp, c, valid=valid, w=w)
     # (G, g, k, E) -> slot-major (G, k·g, E): all slot-0 rows first, so
     # the running count gives slot 0 strictly higher buffer priority.
     oh = onehot.transpose(0, 2, 1, 3).reshape(G, k * g, E)
@@ -1138,29 +1181,29 @@ def _moe_mlp_routed(h, mlp, c: TransformerConfig):
     # the drop: unselected entries (pos == -1) and capacity overflow
     # (pos >= C) land in no buffer slot.
     slot = jax.nn.one_hot(pos, C, dtype=jnp.float32)  # (G, k·g, E, C)
-    w = topv.transpose(0, 2, 1).reshape(G, k * g)     # slot-major wts
-    combine = (jnp.einsum("gt,gtec->gtec", w, slot)
+    wts = topv.transpose(0, 2, 1).reshape(G, k * g)   # slot-major wts
+    combine = (jnp.einsum("gt,gtec->gtec", wts, slot)
                .reshape(G, k, g, E, C)
                .sum(axis=1))                          # (G, g, E, C)
     dispatch = combine > 0.0
 
     expert_in = jnp.einsum("gsec,gsd->gecd", dispatch.astype(dt), x)
-    up = jnp.einsum("gecd,edf->gecf", expert_in, mlp["wi"].astype(dt))
+    up = jnp.einsum("gecd,edf->gecf", expert_in, w(mlp["wi"], dt))
     # Deliberately un-named: under remat_policy="mlp"'s allow-list the
     # (G, E, C, F) expert hiddens — the routed path's biggest
     # residuals — are recomputed in backward.
     up = jax.nn.gelu(up)
-    down = jnp.einsum("gecf,efd->gecd", up, mlp["wo"].astype(dt))
+    down = jnp.einsum("gecf,efd->gecd", up, w(mlp["wo"], dt))
     out = jnp.einsum("gsec,gecd->gsd", combine.astype(dt), down)
     return out.reshape(T_pad, D)[:T].reshape(B, S, D), aux
 
 
-def _moe_mlp(h: jax.Array, mlp: dict, c: TransformerConfig
-             ) -> tuple[jax.Array, jax.Array]:
+def _moe_mlp(h: jax.Array, mlp: dict, c: TransformerConfig,
+             w=_cast_w) -> tuple[jax.Array, jax.Array]:
     """Top-k routed expert MLP; dispatch per ``cfg.moe_impl``."""
     if c.moe_impl == "routed":
-        return _moe_mlp_routed(h, mlp, c)
-    return _moe_mlp_dense(h, mlp, c)
+        return _moe_mlp_routed(h, mlp, c, w=w)
+    return _moe_mlp_dense(h, mlp, c, w=w)
 
 
 def build_transformer(name: str, loss: str = "auto",

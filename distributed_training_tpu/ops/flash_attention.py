@@ -537,8 +537,15 @@ def _flash_bwd(q, k, v, out, lse, do, *, causal, block_q, block_k,
             do.astype(jnp.float32) * out.astype(jnp.float32),
             axis=-1, keepdims=True)  # (B, H, S, 1) — fuses in XLA
 
+    # DTT_FLASH_SPLIT_BWD=1 forces the two-kernel path — the chip
+    # session A/Bs the fused kernel against it on real hardware
+    # (benchmarks/chip_session.sh) before the fused default is trusted.
+    import os
+
     dq_resident = S * D * (4 + jnp.dtype(grads_dtype or q.dtype).itemsize)
-    if dq_resident <= _FUSED_BWD_DQ_RESIDENT_LIMIT_BYTES:
+    if (dq_resident <= _FUSED_BWD_DQ_RESIDENT_LIMIT_BYTES
+            and os.environ.get("DTT_FLASH_SPLIT_BWD", "0")
+            in ("", "0")):
         return _flash_bwd_fused(q, k, v, lse, do, delta, causal=causal,
                                 block_q=block_q, block_k=block_k,
                                 window=window, grads_dtype=grads_dtype)

@@ -252,10 +252,16 @@ class FullyShardedDataParallel(ShardingStrategy):
     """ZeRO-3: every large param sharded over the ``fsdp`` axis.
 
     The compiled counterpart of torch FSDP's flat-param sharding
-    (reference: src/dist_strategy/fsdp_strategy.py:17-26): XLA emits
-    all-gather where a sharded param is consumed in the forward/backward
-    and reduce-scatter for its gradient. With logical axes present, the
-    shard dim follows ``rules``; otherwise the largest divisible dim.
+    (reference: src/dist_strategy/fsdp_strategy.py:17-26). The
+    gather-weights-for-compute half of the contract is NOT left to the
+    partitioner's cost model: measured via
+    benchmarks/audit_collectives.py, XLA preferred partial matmuls on
+    weight shards plus ACTIVATION-shaped all-reduces. The Trainer
+    therefore binds the model's gather-for-compute constraint
+    (``TrainConfig.fsdp_gather_for_compute``) so weights all-gather
+    per layer and activations never pay collective traffic. With
+    logical axes present, the storage shard dim follows ``rules``;
+    otherwise the largest divisible dim.
     """
 
     fsdp_size: int = 1

@@ -206,6 +206,13 @@ class Trainer:
             gather_on_save=tcfg.gather_on_save)
         if hasattr(model, "bind_mesh"):
             model.bind_mesh(runtime.mesh)
+        if (tcfg.fsdp_gather_for_compute
+                and self.strategy.name == "fsdp"
+                and hasattr(model, "bind_gather_for_compute")):
+            # See TrainConfig.fsdp_gather_for_compute: weights gather
+            # for compute; activations never pay collective traffic.
+            model.bind_gather_for_compute(
+                NamedSharding(runtime.mesh, P()))
 
         total_steps = tcfg.total_steps or (
             loader.steps_per_epoch * tcfg.total_epochs)
