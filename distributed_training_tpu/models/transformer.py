@@ -220,6 +220,28 @@ def _rope(q: jax.Array, k: jax.Array, positions: jax.Array,
     return rot(q), rot(k)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def _gather_for_compute(w, fwd_sharding, bwd_sharding):
+    """Asymmetric sharding constraint for FSDP weights: ``w`` is
+    constrained ``fwd_sharding`` (replicated — the all-gather) in the
+    forward, while the backward pins the cotangent to ``bwd_sharding``
+    (the param's own layout) so gradient sync can lower to
+    reduce-scatter. A plain with_sharding_constraint cannot express
+    this: its VJP applies the SAME sharding to the cotangent."""
+    return jax.lax.with_sharding_constraint(w, fwd_sharding)
+
+
+def _gfc_fwd(w, fwd_sharding, bwd_sharding):
+    return jax.lax.with_sharding_constraint(w, fwd_sharding), None
+
+
+def _gfc_bwd(fwd_sharding, bwd_sharding, _res, g):
+    return (jax.lax.with_sharding_constraint(g, bwd_sharding),)
+
+
+_gather_for_compute.defvjp(_gfc_fwd, _gfc_bwd)
+
+
 def _layer_norm(x: jax.Array, scale: jax.Array, bias: jax.Array
                 ) -> jax.Array:
     dtype = x.dtype
@@ -243,6 +265,7 @@ class Transformer:
         # shard_map).
         self._inside_pp = False
         self._compute_replicate = None  # bind_gather_for_compute
+        self._compute_bwd_specs = {}
 
     def bind_mesh(self, mesh) -> None:
         """Give the model the device mesh (needed only for the
@@ -251,7 +274,8 @@ class Transformer:
         constructed against a concrete mesh)."""
         self.mesh = mesh
 
-    def bind_gather_for_compute(self, sharding) -> None:
+    def bind_gather_for_compute(self, sharding,
+                                bwd_specs: dict | None = None) -> None:
         """FSDP compute contract: constrain weights to ``sharding``
         (replicated) at their cast-to-compute-dtype sites, so XLA
         ALL-GATHERS each weight for its matmuls instead of running
@@ -263,22 +287,40 @@ class Transformer:
         supposed to pay. The constraint sits INSIDE the layer scan on
         the per-layer slice (gathers are layer-by-layer, bf16, and
         transient) and on the embedding table / unembedding head at
-        their single use sites."""
-        self._compute_replicate = sharding
+        their single use sites.
 
-    def _w(self, p: jax.Array, dt) -> jax.Array:
+        ``bwd_specs`` (path → NamedSharding of the PER-SLICE param
+        layout, e.g. "attn/wq" → the stored spec minus the stacked
+        layer dim) upgrades the constraint to an asymmetric custom
+        VJP: replicated on forward (the gather), pinned to the param
+        spec on backward — so each weight COTANGENT is born sharded
+        and gradient sync can compile to reduce-scatter instead of
+        all-reduce + slice. Without it, with_sharding_constraint's
+        self-transposing VJP pins cotangents replicated and forces
+        the 2x all-reduce (measured via audit_collectives)."""
+        self._compute_replicate = sharding
+        self._compute_bwd_specs = bwd_specs or {}
+
+    def _w(self, p: jax.Array, dt, path: str | None = None
+           ) -> jax.Array:
         """Cast a weight to compute dtype; under an FSDP gather-for-
         compute binding, also constrain it replicated (cast FIRST so
-        the gather moves bf16, not fp32 masters). Inside the
-        pipeline's shard_map every mesh axis is manual — a named
-        sharding constraint would be rejected at trace time — so the
-        constraint is skipped there (stage params arrive already
-        gathered per-stage by the pipeline's own specs)."""
+        the gather moves bf16, not fp32 masters). When the binding
+        carries a per-leaf backward spec for ``path``, the asymmetric
+        custom VJP is used so the weight's cotangent is born in the
+        param layout (reduce-scatter-able) instead of replicated.
+        Inside the pipeline's shard_map every mesh axis is manual — a
+        named sharding constraint would be rejected at trace time —
+        so the constraint is skipped there (stage params arrive
+        already gathered per-stage by the pipeline's own specs)."""
         w = p.astype(dt)
-        if self._compute_replicate is not None and not self._inside_pp:
-            w = jax.lax.with_sharding_constraint(
+        if self._compute_replicate is None or self._inside_pp:
+            return w
+        bwd = self._compute_bwd_specs.get(path) if path else None
+        if bwd is None:
+            return jax.lax.with_sharding_constraint(
                 w, self._compute_replicate)
-        return w
+        return _gather_for_compute(w, self._compute_replicate, bwd)
 
     def _mesh_axis_sizes(self) -> dict:
         if self.mesh is None:
@@ -551,11 +593,11 @@ class Transformer:
         bhsd = (not return_kv) and self._bhsd_fast()
         lay = "bhsk" if bhsd else "bshk"
         q = jnp.einsum(f"bsd,dhk->{lay}", h,
-                       self._w(layer["attn"]["wq"], dt))
+                       self._w(layer["attn"]["wq"], dt, "attn/wq"))
         k = jnp.einsum(f"bsd,dhk->{lay}", h,
-                       self._w(layer["attn"]["wk"], dt))
+                       self._w(layer["attn"]["wk"], dt, "attn/wk"))
         v = jnp.einsum(f"bsd,dhk->{lay}", h,
-                       self._w(layer["attn"]["wv"], dt))
+                       self._w(layer["attn"]["wv"], dt, "attn/wv"))
         if c.pos_encoding == "rope":
             q, k = _rope(q, k, positions,
                          layout="bhsd" if bhsd else "bshd")
@@ -566,7 +608,8 @@ class Transformer:
                                layout="bhsd" if bhsd else "bshd")
         attn = name(attn, "attn_out")
         attn_proj = jnp.einsum(f"{lay},hkd->bsd", attn,
-                               self._w(layer["attn"]["wo"], dt))
+                               self._w(layer["attn"]["wo"], dt,
+                                       "attn/wo"))
         if drop is not None:
             attn_proj = drop(attn_proj,
                              rng=jax.random.fold_in(dropout_rng, 0))
@@ -582,11 +625,11 @@ class Transformer:
             # UN-named: under the "mlp" policy's allow-list they are
             # the only recompute (wi-matmul + gelu in backward).
             u = jnp.einsum(
-                "bsd,df->bsf", h, self._w(m["wi"], dt)
+                "bsd,df->bsf", h, self._w(m["wi"], dt, "mlp/wi")
             ) + m["bi"].astype(dt)
             u = jax.nn.gelu(u)
             mlp_out = jnp.einsum(
-                "bsf,fd->bsd", u, self._w(m["wo"], dt)
+                "bsf,fd->bsd", u, self._w(m["wo"], dt, "mlp/wo")
             ) + m["bo"].astype(dt)
             aux = jnp.zeros((), jnp.float32)
         if drop is not None:
@@ -611,10 +654,11 @@ class Transformer:
         # indexing, so a vocab-sharded embedding is all-gathered once
         # (param-scale, bf16) instead of the lookup emitting an
         # activation-scale (B, S, D) all-reduce of one-hot partials.
-        x = self._w(params["tok_embed"], dt)[tokens]
+        x = self._w(params["tok_embed"], dt, "tok_embed")[tokens]
         positions = jnp.arange(S)
         if c.pos_encoding == "learned":
-            x = x + self._w(params["pos_embed"], dt)[:S]
+            x = x + self._w(params["pos_embed"], dt,
+                            "pos_embed")[:S]
         if dropping:  # GPT-2's embd_pdrop (fold_in needs non-negative)
             x = _dropout(x, rng=jax.random.fold_in(rng, 1_000_003),
                          rate=c.dropout)
@@ -792,7 +836,8 @@ class Transformer:
         an ``rng`` is given; eval/inference is deterministic."""
         x, aux = self._trunk(params, tokens, rng=rng, train=train)
         logits = jnp.einsum("bsd,dv->bsv", x,
-                            self._w(self._head(params), x.dtype))
+                            self._w(self._head(params), x.dtype,
+                                    "head"))
         return logits.astype(jnp.float32), aux
 
     # -- loss --------------------------------------------------------------
@@ -803,7 +848,8 @@ class Transformer:
         if self.cfg.loss_impl == "fused":
             from distributed_training_tpu.ops.xent import lm_cross_entropy
             x, aux = self._trunk(params, inputs, rng=rng, train=train)
-            nll = lm_cross_entropy(x, self._w(self._head(params), x.dtype),
+            nll = lm_cross_entropy(
+                x, self._w(self._head(params), x.dtype, "head"),
                                    targets)
             # Negative target ids are masked pad positions (zero nll &
             # gradient inside the op) — average over real tokens only.
@@ -1073,7 +1119,7 @@ class Transformer:
         return fn(params, prompt, rng)
 
 
-def _cast_w(p, dt):
+def _cast_w(p, dt, path=None):
     """Default weight consumer for the MoE helpers: plain cast. The
     train path passes ``Transformer._w`` instead so expert/router
     weights get the FSDP gather-for-compute constraint (without it,
@@ -1093,7 +1139,8 @@ def _moe_router(h: jax.Array, mlp: dict, c: TransformerConfig,
     no capacity slots) and from the aux statistics."""
     dt = h.dtype
     E, k = c.moe_num_experts, c.moe_top_k
-    gates = jnp.einsum("...d,de->...e", h, w(mlp["router"], dt))
+    gates = jnp.einsum("...d,de->...e", h,
+                       w(mlp["router"], dt, "mlp/router"))
     probs = jax.nn.softmax(gates.astype(jnp.float32), axis=-1)
     topv, topi = jax.lax.top_k(probs, k)              # (..., k)
     topv = topv / jnp.sum(topv, axis=-1, keepdims=True)
@@ -1119,11 +1166,12 @@ def _moe_mlp_dense(h, mlp, c: TransformerConfig, w=_cast_w):
     dt = h.dtype
     topv, onehot, aux = _moe_router(h, mlp, c, w=w)
     combine = jnp.einsum("bsk,bske->bse", topv, onehot)  # (B,S,E)
-    up = jnp.einsum("bsd,edf->besf", h, w(mlp["wi"], dt))
+    up = jnp.einsum("bsd,edf->besf", h, w(mlp["wi"], dt, "mlp/wi"))
     # Deliberately un-named: under remat_policy="mlp"'s allow-list the
     # (B, E, S, F) expert hiddens (E× the dense class) are recomputed.
     up = jax.nn.gelu(up)
-    down = jnp.einsum("besf,efd->besd", up, w(mlp["wo"], dt))
+    down = jnp.einsum("besf,efd->besd", up,
+                      w(mlp["wo"], dt, "mlp/wo"))
     out = jnp.einsum("besd,bse->bsd", down, combine.astype(dt))
     return out, aux
 
@@ -1188,12 +1236,14 @@ def _moe_mlp_routed(h, mlp, c: TransformerConfig, w=_cast_w):
     dispatch = combine > 0.0
 
     expert_in = jnp.einsum("gsec,gsd->gecd", dispatch.astype(dt), x)
-    up = jnp.einsum("gecd,edf->gecf", expert_in, w(mlp["wi"], dt))
+    up = jnp.einsum("gecd,edf->gecf", expert_in,
+                    w(mlp["wi"], dt, "mlp/wi"))
     # Deliberately un-named: under remat_policy="mlp"'s allow-list the
     # (G, E, C, F) expert hiddens — the routed path's biggest
     # residuals — are recomputed in backward.
     up = jax.nn.gelu(up)
-    down = jnp.einsum("gecf,efd->gecd", up, w(mlp["wo"], dt))
+    down = jnp.einsum("gecf,efd->gecd", up,
+                      w(mlp["wo"], dt, "mlp/wo"))
     out = jnp.einsum("gsec,gecd->gsd", combine.astype(dt), down)
     return out.reshape(T_pad, D)[:T].reshape(B, S, D), aux
 

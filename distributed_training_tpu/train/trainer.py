@@ -206,14 +206,6 @@ class Trainer:
             gather_on_save=tcfg.gather_on_save)
         if hasattr(model, "bind_mesh"):
             model.bind_mesh(runtime.mesh)
-        if (tcfg.fsdp_gather_for_compute
-                and self.strategy.name == "fsdp"
-                and hasattr(model, "bind_gather_for_compute")):
-            # See TrainConfig.fsdp_gather_for_compute: weights gather
-            # for compute; activations never pay collective traffic.
-            model.bind_gather_for_compute(
-                NamedSharding(runtime.mesh, P()))
-
         total_steps = tcfg.total_steps or (
             loader.steps_per_epoch * tcfg.total_epochs)
         self.optimizer = build_optimizer(tcfg, total_steps)
@@ -247,6 +239,19 @@ class Trainer:
                     self.state_shardings["opt_state"]))
         self.batch_sharding = NamedSharding(runtime.mesh,
                                             self.strategy.batch_spec())
+
+        if (tcfg.fsdp_gather_for_compute
+                and self.strategy.name == "fsdp"
+                and hasattr(model, "bind_gather_for_compute")):
+            # See TrainConfig.fsdp_gather_for_compute: weights gather
+            # for compute; activations never pay collective traffic.
+            # Placed AFTER state_shardings exist: the per-leaf backward
+            # specs (derived from them) make each weight's cotangent
+            # born in the param layout (reduce-scatter-able) instead of
+            # replicated — see Transformer.bind_gather_for_compute.
+            model.bind_gather_for_compute(
+                NamedSharding(runtime.mesh, P()),
+                bwd_specs=self._compute_bwd_specs())
 
         self._step_fn = jax.jit(
             make_train_step(
@@ -307,6 +312,37 @@ class Trainer:
     # -- cooperative stop / health ----------------------------------------
 
     _stop_agreed: bool = False
+
+    def _compute_bwd_specs(self) -> dict:
+        """Per-leaf PARAM-layout shardings for the gather-for-compute
+        asymmetric VJP, keyed by the model's weight paths. Layer
+        params are stored stacked with a leading depth dim — the scan
+        body sees slices, so their spec drops the first entry. The
+        tied head is the embedding transposed, so its spec is the
+        embedding's reversed."""
+        ps = self.state_shardings.get("params")
+        if not isinstance(ps, dict):
+            return {}
+        mesh = self.rt.mesh
+
+        def slice_spec(sh):
+            return NamedSharding(mesh, P(*sh.spec[1:]))
+
+        out: dict = {}
+        for group in ("attn", "mlp"):
+            for name, sh in (ps.get(group) or {}).items():
+                out[f"{group}/{name}"] = slice_spec(sh)
+        for name in ("tok_embed", "pos_embed"):
+            if name in ps:
+                out[name] = ps[name]
+        if "lm_head" in ps:
+            out["head"] = ps["lm_head"]
+        elif "tok_embed" in ps:
+            spec = ps["tok_embed"].spec
+            pads = (None,) * max(0, 2 - len(spec))
+            v_ax, d_ax = (tuple(spec) + pads)[:2]
+            out["head"] = NamedSharding(mesh, P(d_ax, v_ax))
+        return out
 
     def _agreed_stop(self) -> bool:
         """Whether to break the step loop — agreed across ALL hosts.

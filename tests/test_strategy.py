@@ -194,3 +194,48 @@ def test_zero1_shards_moments_replicates_params(cpu8):
     # Params, by contrast, are physically replicated (DDP layout).
     p_leaf = jax.tree.leaves(z.state["params"])[0]
     assert device_frac(p_leaf) == 1.0
+
+
+def test_fsdp_gather_for_compute_preserves_trajectory(cpu8):
+    """The gather-for-compute binding (replicate weights forward,
+    param-spec cotangents backward via the asymmetric custom VJP)
+    changes only communication layout, never numerics: a short FSDP
+    training trajectory must be identical with the binding on and
+    off."""
+    from distributed_training_tpu.config import Config
+    from distributed_training_tpu.data import (ShardedDataLoader,
+                                               SyntheticLMDataset)
+    from distributed_training_tpu.models.transformer import (
+        Transformer, TransformerConfig)
+    from distributed_training_tpu.train.trainer import Trainer
+
+    losses = {}
+    for gather in (True, False):
+        rt = fake_cpu_runtime(8, fsdp=8)
+        cfg = Config()
+        cfg.train.batch_size = 1
+        cfg.train.total_epochs = 1
+        cfg.train.log_every = 0
+        cfg.train.optimizer = "adamw"
+        cfg.train.learning_rate = 0.01
+        cfg.train.parallel_strategy = "fsdp"
+        cfg.train.min_shard_elems = 1
+        cfg.train.fsdp_gather_for_compute = gather
+        model = Transformer(TransformerConfig(
+            vocab_size=64, d_model=32, n_layers=2, n_heads=4,
+            max_seq_len=16, dtype="float32", attention_impl="naive"))
+        ds = SyntheticLMDataset(size=16, seq_len=16, vocab_size=64,
+                                seed=0)
+        loader = ShardedDataLoader(ds, rt, batch_size=1, shuffle=False)
+        trainer = Trainer(cfg, rt, model, loader)
+        assert (model._compute_replicate is not None) == gather
+        if gather:
+            assert "attn/wq" in model._compute_bwd_specs
+            assert "head" in model._compute_bwd_specs
+        run = []
+        for batch in loader.epoch(0):
+            run.append(float(trainer.train_step(batch)["loss"]))
+        losses[gather] = run
+    import numpy as np
+    np.testing.assert_allclose(losses[True], losses[False],
+                               rtol=1e-6, atol=1e-7)
