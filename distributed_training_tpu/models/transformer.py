@@ -1176,76 +1176,84 @@ def _moe_mlp_dense(h, mlp, c: TransformerConfig, w=_cast_w):
     return out, aux
 
 
-def _moe_group_size(T: int, cap: int) -> tuple[int, int]:
-    """Group size and padded token count: T pads UP to a multiple of
-    ``min(T, cap)`` rather than shrinking the group to a divisor — a
-    divisor search would collapse to tiny groups for poorly-composite T
-    (e.g. T=2·1031), exploding the per-group capacity overhead. Pad
-    rows are masked out of routing entirely."""
-    g = min(T, max(1, cap))
-    return g, -(-T // g) * g
+def _moe_group_size(S: int, cap: int) -> tuple[int, int]:
+    """Routing-group length along the SEQUENCE axis and the padded
+    sequence length: S pads UP to a multiple of ``min(S, cap)`` rather
+    than shrinking the group to a divisor — a divisor search would
+    collapse to tiny groups for poorly-composite lengths (e.g. 1031),
+    exploding the per-group capacity overhead. Pad positions are
+    masked out of routing entirely."""
+    g = min(S, max(1, cap))
+    return g, -(-S // g) * g
 
 
 def _moe_mlp_routed(h, mlp, c: TransformerConfig, w=_cast_w):
     """Capacity-bounded top-k dispatch (GShard-style, TPU-first).
 
-    Tokens are flattened, split into groups of ≤ ``moe_group_size``, and
-    each group routes its tokens into per-expert capacity buffers
-    ``C = ceil(cf · k · g / E)``: position-in-expert comes from a
-    slot-major cumsum (slot 0 beats slot 1 on overflow — earlier/higher
-    top-k choices win buffer slots), overflowing tokens are dropped
-    (their combine weight never lands in a buffer slot, standard GShard
-    semantics). Dispatch/combine are one-hot einsums — pure MXU work
-    that shards over the ``expert`` axis under EP — and expert FLOPs are
-    ``4·D·F·cf·k·T``: independent of E at fixed top_k, vs the dense
-    path's O(E). Grouping bounds the (g, E, C) dispatch tensor and the
-    dispatch-einsum FLOPs (``g·D·cf·k·T``), which would otherwise rival
-    the expert compute itself at large T.
+    Groups are SEQUENCE chunks within each batch row — the batch axis
+    is never flattened into the group axis, so a dp/fsdp-sharded
+    batch stays shard-local through routing and dispatch (the same
+    sharding contract as ops/xent.py; an earlier version grouped
+    flat (B*S) tokens, which made the SPMD partitioner gather
+    routing tensors across data-parallel ranks —
+    benchmarks/audit_collectives.py). Each (row, group) routes its
+    ``gs`` tokens into per-expert capacity buffers
+    ``C = ceil(cf * k * gs / E)``: position-in-expert comes from a
+    slot-major cumsum (slot 0 beats slot 1 on overflow — earlier/
+    higher top-k choices win buffer slots), overflowing tokens are
+    dropped (their combine weight never lands in a buffer slot,
+    standard GShard semantics). Dispatch/combine are one-hot einsums
+    — pure MXU work that shards over the ``expert`` axis under EP —
+    and expert FLOPs are ``4*D*F*cf*k*T``: independent of E at fixed
+    top_k, vs the dense path's O(E). Grouping bounds the (gs, E, C)
+    dispatch tensor and the dispatch-einsum FLOPs, which would
+    otherwise rival the expert compute itself at large T.
     """
     dt = h.dtype
     E, k = c.moe_num_experts, c.moe_top_k
     B, S, D = h.shape
-    T = B * S
-    g, T_pad = _moe_group_size(T, c.moe_group_size)
-    G = T_pad // g
-    C = int(-(-c.moe_capacity_factor * k * g // E))  # ceil
-    C = min(C, g * k)  # can't hold more than every (token, slot)
+    gs, S_pad = _moe_group_size(S, c.moe_group_size)
+    G = S_pad // gs
+    C = int(-(-c.moe_capacity_factor * k * gs // E))  # ceil
+    C = min(C, gs * k)  # can't hold more than every (token, slot)
 
-    x = h.reshape(T, D)
+    x = h
     valid = None
-    if T_pad != T:
+    if S_pad != S:
         x = jnp.concatenate(
-            [x, jnp.zeros((T_pad - T, D), x.dtype)], axis=0)
-        valid = (jnp.arange(T_pad) < T).reshape(G, g)
-    x = x.reshape(G, g, D)
+            [x, jnp.zeros((B, S_pad - S, D), x.dtype)], axis=1)
+        valid = jnp.broadcast_to(
+            jnp.arange(S_pad) < S, (B, S_pad)).reshape(B, G, gs)
+    x = x.reshape(B, G, gs, D)
     topv, onehot, aux = _moe_router(x, mlp, c, valid=valid, w=w)
-    # (G, g, k, E) -> slot-major (G, k·g, E): all slot-0 rows first, so
-    # the running count gives slot 0 strictly higher buffer priority.
-    oh = onehot.transpose(0, 2, 1, 3).reshape(G, k * g, E)
-    pos = (jnp.cumsum(oh, axis=1) * oh - 1.0).astype(
+    # (B, G, gs, k, E) -> slot-major (B, G, k*gs, E): all slot-0 rows
+    # first, so the running count gives slot 0 strictly higher buffer
+    # priority.
+    oh = onehot.transpose(0, 1, 3, 2, 4).reshape(B, G, k * gs, E)
+    pos = (jnp.cumsum(oh, axis=2) * oh - 1.0).astype(
         jnp.int32
-    )                                                 # (G, k·g, E)
+    )                                                 # (B, G, k*gs, E)
     # one_hot maps out-of-range indices to the zero vector, which IS
     # the drop: unselected entries (pos == -1) and capacity overflow
     # (pos >= C) land in no buffer slot.
-    slot = jax.nn.one_hot(pos, C, dtype=jnp.float32)  # (G, k·g, E, C)
-    wts = topv.transpose(0, 2, 1).reshape(G, k * g)   # slot-major wts
-    combine = (jnp.einsum("gt,gtec->gtec", wts, slot)
-               .reshape(G, k, g, E, C)
-               .sum(axis=1))                          # (G, g, E, C)
+    slot = jax.nn.one_hot(pos, C, dtype=jnp.float32)  # (B,G,k*gs,E,C)
+    wts = topv.transpose(0, 1, 3, 2).reshape(B, G, k * gs)
+    combine = (jnp.einsum("bgt,bgtec->bgtec", wts, slot)
+               .reshape(B, G, k, gs, E, C)
+               .sum(axis=2))                          # (B, G, gs, E, C)
     dispatch = combine > 0.0
 
-    expert_in = jnp.einsum("gsec,gsd->gecd", dispatch.astype(dt), x)
-    up = jnp.einsum("gecd,edf->gecf", expert_in,
+    expert_in = jnp.einsum("bgsec,bgsd->bgecd", dispatch.astype(dt), x)
+    up = jnp.einsum("bgecd,edf->bgecf", expert_in,
                     w(mlp["wi"], dt, "mlp/wi"))
     # Deliberately un-named: under remat_policy="mlp"'s allow-list the
-    # (G, E, C, F) expert hiddens — the routed path's biggest
+    # (B, G, E, C, F) expert hiddens — the routed path's biggest
     # residuals — are recomputed in backward.
     up = jax.nn.gelu(up)
-    down = jnp.einsum("gecf,efd->gecd", up,
+    down = jnp.einsum("bgecf,efd->bgecd", up,
                       w(mlp["wo"], dt, "mlp/wo"))
-    out = jnp.einsum("gsec,gecd->gsd", combine.astype(dt), down)
-    return out.reshape(T_pad, D)[:T].reshape(B, S, D), aux
+    out = jnp.einsum("bgsec,bgecd->bgsd", combine.astype(dt), down)
+    return out.reshape(B, S_pad, D)[:, :S], aux
 
 
 def _moe_mlp(h: jax.Array, mlp: dict, c: TransformerConfig,

@@ -568,13 +568,15 @@ def test_fsdp_step_has_no_activation_scale_collectives():
 
     # Same contract for a routed-MoE model: expert/router weights are
     # fsdp-sharded too (strategy rules route 'expert' onto fsdp) and
-    # flow through the same gather-for-compute constraint. KNOWN
-    # remainder: _moe_mlp_routed's grouping flattens (B·S) tokens —
-    # the same batch-axis merge the xent fix removed — which costs a
-    # router-stat-scale gather (one 64 KB row at this scale). Bounded
-    # here (< 10% of collective bytes, each row < 1 MB) until the
-    # grouping is made batch-preserving; the expert-weight and
-    # dispatch tensors themselves must stay clean.
+    # flow through the same gather-for-compute constraint; the
+    # grouping is batch-preserving (sequence-chunk groups) so routing
+    # and dispatch stay shard-local. KNOWN remainder: the
+    # load-balance aux statistics reduce routing probs over ALL
+    # tokens, and the partitioner gathers the (B, G, gs, E) probs
+    # instead of reducing locally and psumming an (E,)-vector — one
+    # 64 KB row at this scale. Bounded here (< 10% of collective
+    # bytes, each row < 1 MB); the expert-weight and dispatch tensors
+    # themselves must stay clean.
     text = ac.compile_step_hlo(
         8, "fsdp", {"fsdp": 8},
         {"moe_num_experts": 4, "moe_group_size": 64})

@@ -38,7 +38,8 @@ def _mlp_params(c, seed=0):
 
 
 def test_group_size_pads_up():
-    """Group size never collapses for poorly-composite T; T pads up."""
+    """Group size never collapses for poorly-composite sequence
+    lengths; S pads up (groups are per-row sequence chunks)."""
     assert _moe_group_size(1024, 1024) == (1024, 1024)
     assert _moe_group_size(2048, 1024) == (1024, 2048)
     assert _moe_group_size(992, 1024) == (992, 992)
