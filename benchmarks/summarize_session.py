@@ -82,6 +82,13 @@ def summarize(session_dir: str) -> dict:
 
     out["resnet18"] = _json_doc(os.path.join(session_dir, "resnet.out"))
 
+    # Newer session phases (r4 window-4 plan): the fused-vs-split
+    # flash-backward A/B and the long-context point.
+    for phase, key in (("splitbwd", "split_bwd_ab"),
+                       ("long2k", "long_context_2k")):
+        rows = _json_lines(os.path.join(session_dir, f"{phase}.out"))
+        out[key] = rows[-1] if rows else None
+
     with os.scandir(session_dir) as it:
         for e in it:
             if e.name.startswith("analyze_trace") and \
