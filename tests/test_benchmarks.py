@@ -396,16 +396,17 @@ def test_failure_record_carries_prior_evidence(tmp_path, monkeypatch):
     assert "last_measured" not in rec
 
     (tmp_path / "a_old.json").write_text(json.dumps(
-        {"value": 0.2, "measured_at_unix": 100}))
+        {"metric": "m", "value": 0.2, "measured_at_unix": 100}))
     (tmp_path / "z_mid.json").write_text(json.dumps(
-        {"value": 0.25, "measured_at_unix": 200}))
+        {"metric": "m", "value": 0.25, "measured_at_unix": 200}))
     rec = bench._failure_record("probe_backend", "dead")
     assert rec["last_measured"]["value"] == 0.25
 
     # A result WITHOUT a hardware identity (every stubbed test result)
     # must be rejected — fake data must never become "prior hardware
     # evidence".
-    bench.record_evidence({"value": 0.5, "detail": {"batch": 16}})
+    bench.record_evidence(
+        {"metric": "m", "value": 0.5, "detail": {"batch": 16}})
     rec = bench._failure_record("measure", "oom")
     assert rec["last_measured"]["value"] == 0.25
 
@@ -413,10 +414,69 @@ def test_failure_record_carries_prior_evidence(tmp_path, monkeypatch):
     # then wins; corrupt files are skipped, never fatal.
     (tmp_path / "corrupt.json").write_text("{not json")
     bench.record_evidence(
-        {"value": 0.28, "detail": {"device_kind": "TPU v5 lite"}})
+        {"metric": "m", "value": 0.28,
+         "detail": {"device_kind": "TPU v5 lite"}})
     rec = bench._failure_record("measure", "oom")
     assert rec["last_measured"]["value"] == 0.28
     assert rec["value"] == 0.0  # the failure itself is still a failure
+
+
+def test_failure_record_ignores_prose_ledger_entries(tmp_path,
+                                                     monkeypatch):
+    """r4 regression: a newer free-form session-notes ledger entry (no
+    metric/value keys) must NOT win the recency race — it bloated the
+    failure line past the driver's 2,000-char tail and zeroed the
+    round's official number (BENCH_r04 ``parsed: null``)."""
+    import bench
+
+    monkeypatch.setattr(bench, "EVIDENCE_DIR", str(tmp_path))
+    (tmp_path / "good.json").write_text(json.dumps(
+        {"metric": "m", "value": 0.42, "unit": "mfu",
+         "measured_at_unix": 100}))
+    (tmp_path / "notes.json").write_text(json.dumps(
+        {"provenance": "session prose " * 100,
+         "measured_at_unix": 999}))
+    rec = bench._failure_record("probe_backend", "dead")
+    assert rec["last_measured"]["value"] == 0.42
+
+
+def test_failure_record_line_stays_under_tail_budget(tmp_path,
+                                                     monkeypatch):
+    """The emitted failure JSON line must fit the driver's tail capture
+    regardless of what the ledger holds: the embedded prior is reduced
+    to a fixed key set and the whole line is shed to <= MAX_LINE_BYTES."""
+    import bench
+
+    monkeypatch.setattr(bench, "EVIDENCE_DIR", str(tmp_path))
+    # A schema-valid entry that also drags along kilobytes of extras.
+    (tmp_path / "fat.json").write_text(json.dumps(
+        {"metric": "m", "value": 0.42, "unit": "mfu",
+         "vs_baseline": 1.05, "measured_at_unix": 100,
+         "detail": {"device_kind": "TPU v5 lite", "batch": 32,
+                    "tokens_per_sec_per_chip": 104712.7,
+                    "step_time_ms": 312.93,
+                    "model_kwargs": {"remat": True},
+                    "junk": "x" * 4000},
+         "session_notes": "y" * 4000}))
+    rec = bench._failure_record("measure", "boom " * 200)
+    line = json.dumps(rec)
+    assert len(line) <= bench.MAX_LINE_BYTES
+    # The compact prior survived, without the oversized extras.
+    assert rec["last_measured"]["value"] == 0.42
+    assert "junk" not in rec["last_measured"].get("detail", {})
+    assert "session_notes" not in rec["last_measured"]
+    # Core schema keys are intact and the line parses round-trip.
+    parsed = json.loads(line)
+    assert parsed["metric"] == "gpt2_125m_train_mfu_single_chip"
+    assert parsed["value"] == 0.0
+
+    # Non-ASCII escapes inflate SERIALIZED length ~12x per char; the
+    # budget must hold against the serialized line, not char counts —
+    # and the message, not the prior evidence, is what gets shed (the
+    # whole point of the record is carrying the measured number).
+    rec = bench._failure_record("measure", "\U0001f600" * 500)
+    assert len(json.dumps(rec)) <= bench.MAX_LINE_BYTES
+    assert rec["last_measured"]["value"] == 0.42
 
 
 def test_tune_headline_ad_hoc_points(monkeypatch, capsys):
