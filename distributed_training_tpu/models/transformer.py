@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import functools
 import math
+import os
 import warnings
 from dataclasses import dataclass
 
@@ -105,6 +106,11 @@ class TransformerConfig:
     # materializes (B, S, V) logits, the HBM hog that caps batch size.
     # "dense": materialize fp32 logits + log_softmax (reference-style).
     loss_impl: str = "fused"
+    # Row budget per xent scan chunk (ops/xent.py DEFAULT_CHUNK_ROWS);
+    # the live (rows, V) fp32 logits buffer holds ~this many rows.
+    # A bench-sweep knob: bigger chunks = fewer scan steps / bigger
+    # matmuls vs a larger live buffer.
+    xent_chunk_rows: int = 2048
 
     def __post_init__(self):
         if self.n_kv_heads == 0:
@@ -168,6 +174,13 @@ FLASH_RESIDUAL_NAMES = ("flash_out", "flash_lse")
 MLP_POLICY_SAVED = ("ln1_out", "q_rope", "k_rope", "v_proj",
                     "attn_out", "resid_attn", "ln2_out",
                     *FLASH_RESIDUAL_NAMES)
+
+# DTT_NO_BHSD=1 keeps attention in the BSHD einsum layout (disables
+# the _bhsd_fast path) — the chip session A/Bs the layout fast path on
+# real hardware. Read once at import so the knob can't flip between
+# already-compiled shapes mid-process (jit cache keys don't include
+# env vars); process-start-only, like DTT_FLASH_SPLIT_BWD.
+_NO_BHSD = os.environ.get("DTT_NO_BHSD", "0") not in ("", "0")
 
 # Reference hyperparameters for the BASELINE.json ladder. Vocab is
 # GPT-2's 50257 padded to 50304 (next multiple of 128): lane-aligned
@@ -327,27 +340,42 @@ class Transformer:
             return {}
         return dict(zip(self.mesh.axis_names, self.mesh.devices.shape))
 
-    def _flash_active(self) -> bool:
-        """Will attention run through the Pallas flash custom-VJP?
+    def _flash_active(self, seq_len: int) -> bool:
+        """Will attention at ``seq_len`` run through the Pallas flash
+        custom-VJP? Trace-time mirror of the dispatch in
+        ops/attention.py, used to pick which attention-output name the
+        remat allow-lists save.
 
-        Trace-time mirror of the dispatch in ops/attention.py: True
-        for impl='flash', and for 'auto' on a TPU backend (the ring
-        and ulysses layouts route their per-block attention through
-        the same kernels). Used to pick which attention-output name
-        the remat allow-lists save. Edge case (documented, cheap-not-
-        wrong): 'auto' on TPU with tile-unfriendly shapes demotes to
-        naive per-shape, in which case the saved flash names don't
-        exist and the backward recomputes attention from the saved
-        q/k/v tags — extra FLOPs, identical numerics."""
-        from distributed_training_tpu.ops.flash_attention import (
-            _platform_is_tpu,
-        )
-        impl = self.cfg.attention_impl
+        Mirrors ``flash_attention.supported()`` on the EFFECTIVE
+        local-attention shapes rather than just the backend (ADVICE
+        r4): a True here while dispatch demotes to naive per-shape
+        saves residual names that never exist in the trace, and the
+        backward silently recomputes all attention from the q/k/v tags
+        — for ulysses that recompute includes the all-to-alls (always
+        the case on CPU test meshes, where supported() is False).
+        impl='flash' forces the kernel unconditionally at dispatch,
+        and the ring names flash_out/flash_lse inside its own custom
+        VJP for every inner path, so both resolve by impl alone."""
+        from distributed_training_tpu.ops import flash_attention as fa
+        c = self.cfg
+        impl = c.attention_impl
         if impl == "naive":
             return False
-        return impl != "auto" or _platform_is_tpu()
+        if impl in ("flash", "ring"):
+            return True
+        # 'auto' (single-device) and 'ulysses' (local attention over
+        # the full sequence after the a2a; head counts shrink by
+        # tp*sp, which preserves the H % Hkv ratio supported()
+        # checks, so global counts predict the same answer).
+        Dh = c.d_model // c.n_heads
+        dt = jnp.dtype(c.dtype)
+        q_s = jax.ShapeDtypeStruct((1, seq_len, c.n_heads, Dh), dt)
+        kv = jax.ShapeDtypeStruct(
+            (1, seq_len, c.n_kv_heads or c.n_heads, Dh), dt)
+        return fa.supported(q_s, kv, kv, block_q=c.flash_block_q,
+                            block_k=c.flash_block_k, layout="bshd")
 
-    def _bhsd_fast(self) -> bool:
+    def _bhsd_fast(self, seq_len: int) -> bool:
         """Run the block's attention segment natively in (B, H, S, D)?
 
         The flash kernels work in BHSD; with the model's default BSHD
@@ -359,9 +387,12 @@ class Transformer:
         BHSD directly instead (XLA folds the output permutation into
         the matmul), rope and the residual tags follow, and no layout
         churn remains. Ring/Ulysses keep the BSHD contract — they
-        shard the sequence axis and manage their own layouts."""
-        return (self._flash_active()
-                and self.cfg.attention_impl in ("auto", "flash"))
+        shard the sequence axis and manage their own layouts.
+        DTT_NO_BHSD=1 disables the fast path (chip A/B; read once at
+        import — process-start-only, like DTT_FLASH_SPLIT_BWD)."""
+        return (not _NO_BHSD
+                and self.cfg.attention_impl in ("auto", "flash")
+                and self._flash_active(seq_len))
 
     def _attention(self, q, k, v, layout: str = "bshd"):
         c = self.cfg
@@ -590,7 +621,7 @@ class Transformer:
         # wrapper's per-layer q/k/v/out transposes (and their remat
         # recompute in backward) vanish. Everything else (ring,
         # ulysses, naive) keeps the BSHD contract.
-        bhsd = (not return_kv) and self._bhsd_fast()
+        bhsd = (not return_kv) and self._bhsd_fast(x.shape[1])
         lay = "bhsk" if bhsd else "bshk"
         q = jnp.einsum(f"bsd,dhk->{lay}", h,
                        self._w(layer["attn"]["wq"], dt, "attn/wq"))
@@ -795,7 +826,7 @@ class Transformer:
                 # consumes: flash's VJP needs its own residuals (the
                 # BSHD twin is then one cheap transpose away), the
                 # naive path has no flash residuals at all.
-                if self._flash_active():
+                if self._flash_active(x.shape[1]):
                     attn_names = FLASH_RESIDUAL_NAMES
                 else:
                     attn_names = ("attn_out",)
@@ -850,7 +881,7 @@ class Transformer:
             x, aux = self._trunk(params, inputs, rng=rng, train=train)
             nll = lm_cross_entropy(
                 x, self._w(self._head(params), x.dtype, "head"),
-                                   targets)
+                targets, chunk_rows=self.cfg.xent_chunk_rows)
             # Negative target ids are masked pad positions (zero nll &
             # gradient inside the op) — average over real tokens only.
             valid = jnp.sum(targets >= 0)
@@ -1128,6 +1159,37 @@ def _cast_w(p, dt, path=None):
     return p.astype(dt)
 
 
+def _topk_by_argmax(p: jax.Array, k: int):
+    """Top-k along the last axis via k iterations of argmax + mask.
+
+    Identical selection, ordering AND gradient to ``jax.lax.top_k``
+    (descending values, first-index tie-break; cotangent scattered
+    only to the selected indices), but it lowers to plain reduces and
+    gathers over the UNSHARDED expert axis — lax.top_k becomes a TopK
+    custom-call the SPMD partitioner cannot partition, so it
+    all-gathered the full (B, G, gs, E) routing probs across data-
+    parallel shards before routing (the one activation-scale
+    collective in the otherwise-clean MoE communication contract,
+    BENCH_r04; now pinned to zero by
+    tests/test_benchmarks.py::test_fsdp_step_has_no_activation_scale_collectives).
+    k is the tiny moe_top_k (1-2 in practice), so the unrolled loop
+    costs k cheap (…, E) passes. Values are re-gathered from the
+    ORIGINAL tensor via take_along_axis — jnp.max's VJP would split
+    the cotangent across tied maxima (e.g. a freshly-initialized
+    router where every expert ties), leaking gradient onto unselected
+    experts."""
+    orig = p
+    vals, idxs = [], []
+    for _ in range(k):
+        i = jnp.argmax(p, axis=-1)
+        vals.append(jnp.take_along_axis(orig, i[..., None],
+                                        axis=-1)[..., 0])
+        idxs.append(i)
+        p = jnp.where(jax.nn.one_hot(i, p.shape[-1], dtype=jnp.bool_),
+                      -jnp.inf, p)
+    return jnp.stack(vals, -1), jnp.stack(idxs, -1)
+
+
 def _moe_router(h: jax.Array, mlp: dict, c: TransformerConfig,
                 valid: jax.Array | None = None, w=_cast_w):
     """Shared routing head: normalized top-k weights/indices + the
@@ -1142,7 +1204,7 @@ def _moe_router(h: jax.Array, mlp: dict, c: TransformerConfig,
     gates = jnp.einsum("...d,de->...e", h,
                        w(mlp["router"], dt, "mlp/router"))
     probs = jax.nn.softmax(gates.astype(jnp.float32), axis=-1)
-    topv, topi = jax.lax.top_k(probs, k)              # (..., k)
+    topv, topi = _topk_by_argmax(probs, k)            # (..., k)
     topv = topv / jnp.sum(topv, axis=-1, keepdims=True)
     onehot = jax.nn.one_hot(topi, E, dtype=jnp.float32)  # (..., k, E)
     red = tuple(range(probs.ndim - 1))

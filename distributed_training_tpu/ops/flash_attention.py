@@ -34,6 +34,7 @@ seq lens are powers of two ≥ 128; others fall back to naive).
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.ad_checkpoint
@@ -454,14 +455,48 @@ def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dq_ref[0, 0] = dq_acc[:].astype(dq_ref.dtype)
 
 
-# VMEM budget for the fused backward's whole-sequence dq residency:
-# the f32 (S, D) scratch PLUS the (1, 1, S, D) dq output block stay
-# resident across the entire sweep (the output block's dtype is
-# grads_dtype — f32 for ring callers). Beyond this, fall back to the
-# two-kernel path; the remaining ~10 MiB of the ~16 MiB/core VMEM
-# covers the q/k/v/do tiles (double-buffered), dk/dv scratch, and the
-# f32 (block_q, block_k) softmax temporaries.
-_FUSED_BWD_DQ_RESIDENT_LIMIT_BYTES = 6 * 1024 * 1024
+# VMEM budget for the fused backward's TOTAL estimated residency.
+# An earlier gate budgeted only the whole-sequence dq scratch + dq
+# output block (6 MiB) and ignored everything else resident with it —
+# the f32 (block_q, block_k) softmax temporaries, dk/dv scratch, and
+# the double-buffered q/k/v/do tiles — so shapes like S=8192, D=128
+# passed the gate and then blew the ~16 MiB/core VMEM in Mosaic
+# (ADVICE r4, medium). The estimate is conservative-but-calibrated:
+# the chip-proven split dq kernel runs the same (block_q, block_k)
+# temporaries at 1024x1024 tiles, which bounds how many Mosaic keeps
+# live simultaneously (~2 f32 copies; s/p and dp/ds alias).
+_FUSED_BWD_VMEM_LIMIT_BYTES = 14 * 1024 * 1024
+
+
+def _fused_bwd_vmem_estimate(S, D, block_q, block_k, in_itemsize,
+                             g_itemsize) -> int:
+    """Estimated peak VMEM residency (bytes) of _bwd_fused_kernel."""
+    dq_resident = S * D * (4 + g_itemsize)       # f32 scratch + out blk
+    softmax_tmp = 2 * block_q * block_k * 4      # live f32 (bq, bk)
+    dkv_scratch = 2 * block_k * D * 4
+    dkv_out = 2 * block_k * D * g_itemsize
+    io_tiles = 2 * 2 * (block_q + block_k) * D * in_itemsize  # dbl-buf
+    return dq_resident + softmax_tmp + dkv_scratch + dkv_out + io_tiles
+
+
+def _fused_bwd_fits(S, D, block_q, block_k, in_dtype, grads_dtype=None):
+    """Gate for the fused single-sweep backward; callers fall back to
+    the chip-proven two-kernel split path when this is False."""
+    g = jnp.dtype(grads_dtype or in_dtype).itemsize
+    return _fused_bwd_vmem_estimate(
+        S, D, block_q, block_k, jnp.dtype(in_dtype).itemsize,
+        g) <= _FUSED_BWD_VMEM_LIMIT_BYTES
+
+
+# DTT_FLASH_SPLIT_BWD=1 forces the two-kernel path — the chip session
+# A/Bs the fused kernel against it on real hardware
+# (benchmarks/chip_session.sh) before the fused default is trusted.
+# Read ONCE at import: the jit cache key does not include env vars, so
+# a mid-process toggle after a shape has compiled would silently reuse
+# the previously chosen kernel and invalidate an in-process A/B
+# (ADVICE r4). The knob is process-start-only by construction.
+_FORCE_SPLIT_BWD = os.environ.get("DTT_FLASH_SPLIT_BWD", "0") not in (
+    "", "0")
 
 
 def _flash_bwd_fused(q, k, v, lse, do, delta, *, causal, block_q,
@@ -537,15 +572,9 @@ def _flash_bwd(q, k, v, out, lse, do, *, causal, block_q, block_k,
             do.astype(jnp.float32) * out.astype(jnp.float32),
             axis=-1, keepdims=True)  # (B, H, S, 1) — fuses in XLA
 
-    # DTT_FLASH_SPLIT_BWD=1 forces the two-kernel path — the chip
-    # session A/Bs the fused kernel against it on real hardware
-    # (benchmarks/chip_session.sh) before the fused default is trusted.
-    import os
-
-    dq_resident = S * D * (4 + jnp.dtype(grads_dtype or q.dtype).itemsize)
-    if (dq_resident <= _FUSED_BWD_DQ_RESIDENT_LIMIT_BYTES
-            and os.environ.get("DTT_FLASH_SPLIT_BWD", "0")
-            in ("", "0")):
+    if (not _FORCE_SPLIT_BWD
+            and _fused_bwd_fits(S, D, block_q, block_k, q.dtype,
+                                grads_dtype)):
         return _flash_bwd_fused(q, k, v, lse, do, delta, causal=causal,
                                 block_q=block_q, block_k=block_k,
                                 window=window, grads_dtype=grads_dtype)

@@ -146,11 +146,31 @@ def test_fused_bwd_matches_two_pass(monkeypatch):
         return jax.grad(f, argnums=(0, 1, 2))(q, k, v)
 
     for kw in ({}, {"window": 96}):
+        # The total-residency gate (ADVICE r4) must keep this small
+        # shape on the fused path, and zeroing the budget forces split.
+        assert fa._fused_bwd_fits(256, 16, 64, 64, jnp.float32)
         fused = grads(**kw)
-        assert fa._FUSED_BWD_DQ_RESIDENT_LIMIT_BYTES >= 256 * 16 * 8
-        monkeypatch.setattr(fa, "_FUSED_BWD_DQ_RESIDENT_LIMIT_BYTES", 0)
+        monkeypatch.setattr(fa, "_FUSED_BWD_VMEM_LIMIT_BYTES", 0)
         split = grads(**kw)
         monkeypatch.undo()
         for a, b in zip(fused, split):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=2e-5, atol=2e-5)
+
+
+def test_fused_bwd_vmem_gate_budgets_full_residency():
+    """ADVICE r4 (medium): the fused-path gate must budget the softmax
+    temporaries, dk/dv scratch, and double-buffered io tiles — not
+    just the dq scratch. Pins the decision on the shapes that matter:
+    the chip-proven headline stays fused; the S=8192 D=128 bf16 case
+    that passed the old dq-only gate (6 MiB exactly) while its true
+    residency exceeds VMEM now falls back to the split kernels."""
+    from distributed_training_tpu.ops import flash_attention as fa
+
+    # gpt2_125m headline: S=1024, D=64, seq-aware 1024x1024 tiles.
+    assert fa._fused_bwd_fits(1024, 64, 1024, 1024, jnp.bfloat16)
+    # The ADVICE overflow shape.
+    assert not fa._fused_bwd_fits(8192, 128, 1024, 1024, jnp.bfloat16)
+    # Ring callers (f32 grads) inflate dq residency ~1.5x.
+    assert not fa._fused_bwd_fits(4096, 128, 1024, 1024, jnp.bfloat16,
+                                  jnp.float32)

@@ -208,6 +208,53 @@ def test_remat_policies_do_not_recompute_flash_kernel():
         assert counts["/scan/remat2"] == 1, (policy, counts)
 
 
+def test_ring_remat_does_not_recompute_forward_ring():
+    """Mirror of test_remat_policies_do_not_recompute_flash_kernel for
+    attention_impl='ring' (ADVICE r4): the ring's custom VJP names its
+    residuals (flash_out/flash_lse at the VJP boundary), so
+    remat_policy='mlp' must not re-run the forward ring — including
+    its ICI rotations — inside the backward remat region. Invariant
+    pinned: the grad jaxpr's total ppermute count under remat='mlp'
+    equals the no-remat count (fwd ring + reverse ring); a failure of
+    checkpoint_name propagation through shard_map + the custom VJP
+    would recompute the forward ring and inflate it."""
+    import jax.extend.core as jex_core
+
+    from distributed_training_tpu.runtime import fake_cpu_runtime
+
+    def count_prim(jaxpr, prim):
+        n = 0
+        for e in jaxpr.eqns:
+            if e.primitive.name == prim:
+                n += 1
+            for v in e.params.values():
+                for item in (v if isinstance(v, (list, tuple)) else [v]):
+                    if isinstance(item, jex_core.ClosedJaxpr):
+                        n += count_prim(item.jaxpr, prim)
+                    elif isinstance(item, jex_core.Jaxpr):
+                        n += count_prim(item, prim)
+        return n
+
+    rt = fake_cpu_runtime(8, sp=2)
+    counts = {}
+    for label, extra in (("noremat", dict(remat=False)),
+                         ("mlp", dict(remat=True,
+                                      remat_policy="mlp"))):
+        model = Transformer(TransformerConfig(
+            vocab_size=64, d_model=32, n_layers=2, n_heads=4,
+            max_seq_len=32, dtype="float32", attention_impl="ring",
+            **extra))
+        model.bind_mesh(rt.mesh)
+        tokens = jnp.zeros((4, 33), jnp.int32)
+        jx = jax.make_jaxpr(jax.grad(
+            lambda p: model.loss(p, {"tokens": tokens},
+                                 jax.random.PRNGKey(1))[0]))(
+            model.init(jax.random.PRNGKey(0)))
+        counts[label] = count_prim(jx.jaxpr, "ppermute")
+    assert counts["noremat"] > 0, counts
+    assert counts["mlp"] == counts["noremat"], counts
+
+
 def test_bhsd_fast_path_matches_naive():
     """attention_impl='flash' routes the block's attention natively in
     (B, H, S, D) — qkv einsums emit the kernel layout, rope follows,
@@ -224,7 +271,7 @@ def test_bhsd_fast_path_matches_naive():
             attention_impl="flash", **cfg))
         naive = Transformer(TransformerConfig(
             attention_impl="naive", **cfg))
-        assert flash._bhsd_fast() and not naive._bhsd_fast()
+        assert flash._bhsd_fast(256) and not naive._bhsd_fast(256)
         params = flash.init(jax.random.PRNGKey(0))
         tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 129),
                                     0, 128)
@@ -239,3 +286,20 @@ def test_bhsd_fast_path_matches_naive():
         jax.tree.map(lambda a, b: np.testing.assert_allclose(
             np.asarray(a), np.asarray(b), rtol=5e-4, atol=1e-5),
             gf, gn)
+
+
+def test_xent_chunk_rows_knob_is_loss_invariant():
+    """cfg.xent_chunk_rows reaches ops/xent.py (the bench sweeps it on
+    chip — chunk geometry trades live-buffer size for scan overhead)
+    and must never change the loss."""
+    kw = dict(vocab_size=128, d_model=32, n_layers=1, n_heads=2,
+              max_seq_len=64, dtype="float32")
+    tokens = jnp.asarray(
+        np.random.default_rng(0).integers(0, 128, (2, 33)), jnp.int32)
+    losses = []
+    for rows in (8, 2048):
+        m = Transformer(TransformerConfig(xent_chunk_rows=rows, **kw))
+        p = m.init(jax.random.PRNGKey(0))
+        losses.append(float(m.loss(
+            p, {"tokens": tokens}, jax.random.PRNGKey(1))[0]))
+    assert losses[0] == pytest.approx(losses[1], rel=1e-6)
