@@ -78,9 +78,51 @@ def _bytes_of(dtype: str, shape: str) -> int:
     return n * _DTYPE_BYTES.get(dtype, 4)
 
 
+# A TPU-pipeline fused reduce-scatter: the executed op is one RS
+# kernel, but its HLO form is a kCustom fusion whose CALLED computation
+# holds an all-reduce + dynamic-slice pair. Count the fusion (output
+# shape = the true bytes moved per receiver) and skip the called
+# computation's body — otherwise the inner all-reduce is double-counted
+# at FULL pre-scatter bytes, which is exactly how the r4 audit misread
+# the TPU grad sync as "all-reduce at 2x optimal traffic".
+_FUSED_RS_LINE = re.compile(
+    r"=\s+(.*?)\s+fusion\([^\n]*kind=kCustom,\s*"
+    r"calls=(%all-reduce-scatter[\w.\-]*)")
+_RS_COMPUTATION = re.compile(r"^(%all-reduce-scatter[\w.\-]*)\s", re.M)
+
+
+def _strip_fused_rs_bodies(text: str, names: set[str]) -> str:
+    """Remove the bodies of the NAMED %all-reduce-scatter called
+    computations so their inner all-reduce/dynamic-slice never reach
+    the parser. Only computations whose calling fusion was actually
+    COUNTED are stripped — a name-based strip with an uncounted caller
+    would make the grad-sync collective vanish from the report
+    entirely (and the zero-collective contract tests pass vacuously)."""
+    out = []
+    for block in re.split(r"\n(?=%|ENTRY)", text):
+        m = _RS_COMPUTATION.match(block)
+        if m and m.group(1) in names:
+            continue
+        out.append(block)
+    return "\n".join(out)
+
+
 def audit_hlo_text(text: str) -> dict:
     """Parse optimized HLO text → per-collective counts and bytes."""
     rows = []
+    counted_rs: set[str] = set()
+    for m in _FUSED_RS_LINE.finditer(text):
+        parts = _TYPE.findall(m.group(1))
+        if not parts:
+            continue
+        total = sum(_bytes_of(dt, sh) for dt, sh in parts)
+        big_dt, big_sh = max(parts, key=lambda p: _bytes_of(p[0], p[1]))
+        rows.append({"kind": "reduce-scatter", "dtype": big_dt,
+                     "shape": big_sh or "scalar",
+                     "tuple_arity": len(parts), "bytes": total,
+                     "fused": True})
+        counted_rs.add(m.group(2))
+    text = _strip_fused_rs_bodies(text, counted_rs)
     for m in _OP_LINE.finditer(text):
         types, kind = m.group(1), m.group(2)
         parts = _TYPE.findall(types)
@@ -109,9 +151,17 @@ def audit_hlo_text(text: str) -> dict:
 
 def compile_step_hlo(n_devices: int, strategy: str,
                      mesh_axes: dict | None = None,
-                     model_kwargs: dict | None = None) -> str:
+                     model_kwargs: dict | None = None,
+                     tpu_topology: str | None = None) -> str:
     """Build the real Trainer on a virtual mesh and return the
-    compiled (SPMD-partitioned) HLO of its jitted train step."""
+    compiled (SPMD-partitioned) HLO of its jitted train step.
+
+    ``tpu_topology`` (e.g. "v5e:2x2") compiles with the REAL TPU
+    compiler against a device-less topology descriptor instead of the
+    CPU backend — the partitioning passes differ (the TPU pipeline
+    runs reduce-scatter-creator; CPU lowers FSDP grad sync as
+    all-reduce + dynamic-slice), so contract claims about what runs
+    on hardware must audit this path (VERDICT r4 item 4)."""
     import jax
 
     jax.config.update("jax_platforms", "cpu")
@@ -120,7 +170,8 @@ def compile_step_hlo(n_devices: int, strategy: str,
     from distributed_training_tpu.data import (ShardedDataLoader,
                                                SyntheticLMDataset)
     from distributed_training_tpu.models import build_model
-    from distributed_training_tpu.runtime import fake_cpu_runtime
+    from distributed_training_tpu.runtime import (fake_cpu_runtime,
+                                                  topology_runtime)
     from distributed_training_tpu.train.trainer import Trainer
 
     cfg = Config()
@@ -129,7 +180,11 @@ def compile_step_hlo(n_devices: int, strategy: str,
     cfg.train.log_every = 0
     cfg.train.min_shard_elems = 1
     cfg.train.dtype = "float32"
-    rt = fake_cpu_runtime(n_devices, **(mesh_axes or {}))
+    if tpu_topology:
+        rt = topology_runtime(n_devices, tpu_topology,
+                              **(mesh_axes or {}))
+    else:
+        rt = fake_cpu_runtime(n_devices, **(mesh_axes or {}))
     mk = dict(vocab_size=256, d_model=64, n_layers=2, n_heads=4,
               max_seq_len=64, dtype="float32")
     mk.update(model_kwargs or {})
@@ -138,9 +193,22 @@ def compile_step_hlo(n_devices: int, strategy: str,
                             seq_len=32, vocab_size=256, seed=0)
     loader = ShardedDataLoader(ds, rt, batch_size=cfg.train.batch_size,
                                shuffle=False)
-    trainer = Trainer(cfg, rt, model, loader)
-    batch = next(iter(loader.epoch(0)))
     import jax.numpy as jnp
+
+    if tpu_topology:
+        # Topology devices hold no data: abstract trainer state and a
+        # ShapeDtypeStruct batch (the loader's global layout).
+        trainer = Trainer(cfg, rt, model, loader, abstract=True)
+        import numpy as np
+        sample = ds.batch(np.arange(1))
+        batch = {
+            k: jax.ShapeDtypeStruct(
+                (loader.global_batch,) + v.shape[1:], v.dtype,
+                sharding=trainer.batch_sharding)
+            for k, v in sample.items()}
+    else:
+        trainer = Trainer(cfg, rt, model, loader)
+        batch = next(iter(loader.epoch(0)))
 
     lowered = trainer._step_fn.lower(trainer.state, batch,
                                      jnp.zeros((2,), jnp.uint32))
@@ -155,6 +223,9 @@ def main() -> int:
                     help="axis sizes, e.g. tp=2,sp=2,fsdp=2 "
                          "(remainder goes to dp)")
     ap.add_argument("--model-kwargs", default="{}")
+    ap.add_argument("--tpu-topology", default=None,
+                    help="compile with the real TPU compiler against "
+                         "a device-less topology (e.g. v5e:2x2)")
     args = ap.parse_args()
     mesh_axes = {}
     if args.mesh:
@@ -162,11 +233,13 @@ def main() -> int:
             k, v = part.split("=")
             mesh_axes[k.strip()] = int(v)
     text = compile_step_hlo(args.devices, args.strategy, mesh_axes,
-                            json.loads(args.model_kwargs))
+                            json.loads(args.model_kwargs),
+                            tpu_topology=args.tpu_topology)
     rep = audit_hlo_text(text)
     rep["devices"] = args.devices
     rep["strategy"] = args.strategy
     rep["mesh"] = mesh_axes
+    rep["tpu_topology"] = args.tpu_topology
     for kind, row in sorted(rep["by_kind"].items(),
                             key=lambda kv: -kv[1]["bytes"]):
         print(f"{kind:20s} x{row['count']:3d}  "

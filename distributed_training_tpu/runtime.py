@@ -296,6 +296,36 @@ def runtime_for_mesh(mesh: Mesh) -> Runtime:
     )
 
 
+def topology_runtime(num_devices: int = 4,
+                     topology_name: str = "v5e:2x2",
+                     **axis_sizes: int) -> Runtime:
+    """A Runtime over DEVICE-LESS TPU topology descriptors
+    (``jax.experimental.topologies``): the real TPU compiler (libtpu)
+    compiles real SPMD programs for the named topology with no
+    attached chips. Audit/AOT use only — the resulting mesh cannot
+    hold data, so pair it with ``Trainer(..., abstract=True)`` and
+    ShapeDtypeStruct inputs. This is how the repo inspects what the
+    TPU backend (vs the CPU partitioner) compiles a sharded step into
+    — e.g. whether FSDP's gradient sync becomes reduce-scatter
+    (benchmarks/audit_collectives.py --tpu-topology)."""
+    from jax.experimental import topologies
+
+    topo = topologies.get_topology_desc(platform="tpu",
+                                        topology_name=topology_name)
+    devices = list(topo.devices)
+    if len(devices) < num_devices:
+        raise RuntimeError_(
+            f"topology {topology_name} has {len(devices)} devices, "
+            f"need {num_devices}")
+    devices = devices[:num_devices]
+    cfg = MeshConfig(**{**{a: 1 for a in MESH_AXES}, "dp": -1,
+                        **axis_sizes})
+    spec = MeshSpec.resolve(cfg, num_devices)
+    return dataclasses.replace(
+        runtime_for_mesh(build_mesh(spec, devices)), platform="tpu",
+        process_index=0, process_count=1)
+
+
 def fake_cpu_runtime(num_devices: int = 8, **axis_sizes: int) -> Runtime:
     """Test/dryrun helper: a Runtime over CPU fake devices.
 

@@ -34,7 +34,7 @@ logger = logging.getLogger(__name__)
 
 def make_train_step(model, optimizer: optax.GradientTransformation,
                     nan_guard: bool = False, grad_accum_steps: int = 1,
-                    microbatch_sharding=None):
+                    microbatch_sharding=None, grad_shardings=None):
     """Build the pure train-step function (pre-jit).
 
     The entire reference ``_run_batch`` (zero_grad → forward → loss →
@@ -111,6 +111,19 @@ def make_train_step(model, optimizer: optax.GradientTransformation,
         rng = jax.random.fold_in(base_rng, step)
 
         (loss, metrics), grads = accumulated_grads(params, batch, rng)
+        if grad_shardings is not None:
+            # Pin gradients to the PARAM layout before any full-tree
+            # consumer (global_norm here; clip inside optimizer.update)
+            # can demand them replicated: with the pin, the batch-axis
+            # reduction lowers to reduce-scatter (the TPU pipeline
+            # fuses all-reduce + slice into an %all-reduce-scatter
+            # kernel) and the grad norm becomes shard-local square-sums
+            # + one scalar psum. Without it, every sharded-param grad
+            # pays a full-shape all-reduce — 2x optimal traffic
+            # (VERDICT r4 item 4; audited via
+            # benchmarks/audit_collectives.py --tpu-topology).
+            grads = jax.lax.with_sharding_constraint(
+                grads, grad_shardings)
         updates, new_opt = optimizer.update(grads, opt_state, params)
         new_params = optax.apply_updates(params, updates)
 
@@ -142,7 +155,7 @@ class Trainer:
 
     def __init__(self, cfg: Config, runtime: Runtime, model,
                  loader, checkpointer=None, preemption_guard=None,
-                 eval_loader=None):
+                 eval_loader=None, abstract: bool = False):
         self.cfg = cfg
         self.rt = runtime
         self.model = model
@@ -259,11 +272,29 @@ class Trainer:
                 grad_accum_steps=tcfg.grad_accum_steps,
                 microbatch_sharding=NamedSharding(
                     runtime.mesh,
-                    P(None, *self.strategy.batch_spec()))),
+                    P(None, *self.strategy.batch_spec())),
+                grad_shardings=self._device_state_shardings["params"]),
             donate_argnums=(0,),
             out_shardings=(self._device_state_shardings,
                            NamedSharding(runtime.mesh, P())),
         )
+
+        if abstract:
+            # AOT/audit mode: every sharding and the jitted step exist,
+            # but nothing is materialized — ``self.state`` is a
+            # ShapeDtypeStruct tree, so ``_step_fn.lower(state, ...)``
+            # compiles against meshes with no attached devices
+            # (runtime.topology_runtime; the TPU reduce-scatter audit).
+            self.epochs_run = 0
+            self.global_step = 0
+            self.state = state_lib.abstract_state(
+                model, self.optimizer, self.init_rng,
+                self._device_state_shardings)
+            self.metrics = MetricsLogger(
+                log_every=0, samples_per_step=loader.global_batch,
+                flops_per_sample=0, num_devices=runtime.num_devices,
+                enabled=False)
+            return
 
         # Resume-if-exists (parity: ModelCheckpoint.load on startup,
         # src/distributed_trainer.py:157,97-105) — but restoring optimizer
@@ -273,10 +304,10 @@ class Trainer:
         self.epochs_run = 0
         restored = None
         if checkpointer is not None:
-            abstract = state_lib.abstract_state(
+            abstract_tree = state_lib.abstract_state(
                 model, self.optimizer, self.init_rng,
                 self._device_state_shardings)
-            restored = checkpointer.restore_latest(abstract)
+            restored = checkpointer.restore_latest(abstract_tree)
         if restored is not None:
             self.state, meta = restored
             self.epochs_run = int(meta.get("epoch", -1)) + 1
