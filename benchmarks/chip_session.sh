@@ -6,21 +6,29 @@
 #
 #   pkill -f probe_loop.sh; bash benchmarks/chip_session.sh
 #
-# Ordering is information-per-chip-second. State after the r4 window-4
-# session (see docs/performance.md measured history): headline 0.427
-# MFU via seq-aware flash tiles + remat residual fix; ladder mostly
-# banked. What the next window must answer:
-#   1. headline    — re-confirm 0.427 on the FINAL committed code (the
-#                    review pass de-duplicated saved attention
-#                    residuals after the 0.427 run; memory-neutral on
-#                    the hot path, but confirm + bank via the evidence
-#                    ledger).
-#   2. trace32     — attribute the remaining gap (0.43 -> 1.0) with
-#                    the new kernel geometry in place.
-#   3. bench1b     — 1B now rides the 1024 tiles too (was 0.320 with
-#                    256-tile kernels).
-#   4. long2k      — seq 2048 at the new defaults (banked 0.322 with
-#                    512-tile overrides).
+# Ordering is information-per-chip-second. R5 plan (VERDICT r4 items
+# 1c/2/6/8/9): HEAD has never produced a measured headline — the r4
+# endgame (fused flash backward, BHSD layout path, seq-chunked xent)
+# plus the r5 fixes (total-VMEM fused gate, shard-local top-k routing)
+# all shipped chip-unmeasured. What this window must answer:
+#   1. headline   — the scored number on HEAD, pure defaults (banked
+#                   0.427 predates every endgame change).
+#   2. splitbwd   — fused single-sweep flash bwd vs the split pair
+#                   (DTT_FLASH_SPLIT_BWD=1; process-start-only knob).
+#   3. bhsd_off   — BHSD layout fast path on (default) vs off
+#                   (DTT_NO_BHSD=1; measured r4: 11.25 ms/step of
+#                   standalone transposes at batch 32 said ON wins).
+#   4. xent_rows  — chunk-size ladder around the 2048-row default.
+#   5. batch48    — the unexplained 0.427→0.380 regression point,
+#                   re-measured on HEAD + traced for attribution.
+#   6. trace32    — attribute the remaining gap (0.43 → 1.0).
+#   7. long8k/16k — windowed long-context (VERDICT 6): equal
+#                   tokens/step across S=8k and S=16k windowed points
+#                   validates the O(S·window) FLOPs claim; the full-
+#                   causal 8k comparator shows the window's win.
+#   8. bench1b    — 1B single chip (was 0.320 with 256-tile kernels).
+#   9. slice7b    — first measured 7B-width signal (VERDICT 9): a
+#                   4-layer 7B-dim slice, batch 1, S=2048, remat.
 # Known traps, demoted: batch-64 dies in the platform's remote compile
 # helper (HTTP 500); batch-32 no-remat hangs >1800 s in compile — do
 # NOT re-attempt either in an automated window, and never let a phase
@@ -29,6 +37,10 @@ set -u
 cd /root/repo
 export PYTHONPATH=/root/repo:/root/.axon_site
 export DTT_BENCH_NO_CLAIM=1
+# Persistent XLA compilation cache shared by every phase (and the
+# bench parent/child): a compile completed once — even by an abandoned
+# child — is never paid again this session.
+export JAX_COMPILATION_CACHE_DIR=/root/repo/benchmarks/state/xla_cache
 OUT=benchmarks/state/session_$(date -u +%Y%m%d_%H%M%S)
 mkdir -p "$OUT"
 echo "chip session -> $OUT"
@@ -42,22 +54,40 @@ phase() {  # phase NAME TIMEOUT_S CMD...
   return $rc
 }
 
-phase headline 1500 python bench.py
-# Kernel A/B on identical config: the fused single-sweep flash
-# backward (default) vs the split FlashAttention-2 pair — the fused
-# kernel landed chip-unmeasured during a 4h+ wedge.
+# 2100: the bench parent self-bounds (probe 480 + child deadline 1500
+# + slack) and ABANDONS a stuck child rather than letting this outer
+# timeout kill anything mid-compile.
+phase headline 2100 python bench.py
 phase splitbwd 1200 env DTT_FLASH_SPLIT_BWD=1 \
   python benchmarks/tune_headline.py --points '[[32, {}]]'
+phase bhsd_off 1200 env DTT_NO_BHSD=1 \
+  python benchmarks/tune_headline.py --points '[[32, {}]]'
+phase xent_rows 1500 python benchmarks/tune_headline.py --points \
+  '[[32, {"xent_chunk_rows": 512}], [32, {"xent_chunk_rows": 8192}]]'
+phase batch48 1200 python benchmarks/tune_headline.py --points '[[48, {}]]'
+phase trace48 1200 python benchmarks/profile_step.py --batch 48 \
+  --model-kwargs '{"remat": true, "remat_policy": "mlp"}' \
+  --trace "$OUT/trace_b48"
 phase trace32 1200 python benchmarks/profile_step.py --batch 32 \
   --model-kwargs '{"remat": true, "remat_policy": "mlp"}' \
   --trace "$OUT/trace_b32"
+# Long-context (shrunk 125M-width model, windowed GQA-free): the two
+# windowed points run the SAME tokens/step (4*8192 == 2*16384), so
+# near-equal step times validate O(S*window); the full-causal 8k
+# comparator quantifies the window's saving.
+phase long8k 1800 python benchmarks/tune_headline.py --points \
+  '[[4, {"seq_len_override": 8192, "max_seq_len": 8192, "attention_window": 1024}], [4, {"seq_len_override": 8192, "max_seq_len": 8192}]]'
+phase long16k 1800 python benchmarks/tune_headline.py --points \
+  '[[2, {"seq_len_override": 16384, "max_seq_len": 16384, "attention_window": 1024}]]'
 phase bench1b 2400 python benchmarks/bench_1b_single_chip.py
-phase long2k 1200 python benchmarks/tune_headline.py --points \
-  '[[16, {"seq_len_override": 2048, "max_seq_len": 2048}]]'
+phase slice7b 1800 python benchmarks/tune_headline.py --points \
+  '[[1, {"d_model": 4096, "n_layers": 4, "n_heads": 32, "n_kv_heads": 8, "d_ff": 16384, "max_seq_len": 2048, "seq_len_override": 2048, "pos_encoding": "rope", "tie_embeddings": false, "remat": true, "remat_policy": "mlp"}]]'
 
 # CPU-side trace analysis (forced off-chip).
-if [ -d "$OUT/trace_b32" ]; then
-  JAX_PLATFORMS=cpu timeout 600 python benchmarks/analyze_trace.py \
-    "$OUT/trace_b32" --json >"$OUT/analyze_trace_b32.json" 2>>"$OUT/session.log"
-fi
+for b in 32 48; do
+  if [ -d "$OUT/trace_b$b" ]; then
+    JAX_PLATFORMS=cpu timeout 600 python benchmarks/analyze_trace.py \
+      "$OUT/trace_b$b" --json >"$OUT/analyze_trace_b$b.json" 2>>"$OUT/session.log"
+  fi
+done
 echo "[session] done $(date -u +%H:%M:%S)" | tee -a "$OUT/session.log"

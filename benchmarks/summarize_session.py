@@ -82,12 +82,22 @@ def summarize(session_dir: str) -> dict:
 
     out["resnet18"] = _json_doc(os.path.join(session_dir, "resnet.out"))
 
-    # Newer session phases (r4 window-4 plan): the fused-vs-split
-    # flash-backward A/B and the long-context point.
+    # Single-point phases: the kernel/layout A/Bs and long-context
+    # points (r4 window-4 + the r5 plan). Multi-point phases keep the
+    # full row list — both points carry information (the xent ladder's
+    # two chunk sizes; long8k's windowed + full-causal pair).
     for phase, key in (("splitbwd", "split_bwd_ab"),
-                       ("long2k", "long_context_2k")):
+                       ("long2k", "long_context_2k"),
+                       ("bhsd_off", "bhsd_off_ab"),
+                       ("batch48", "batch48"),
+                       ("long16k", "long_context_16k"),
+                       ("slice7b", "slice_7b")):
         rows = _json_lines(os.path.join(session_dir, f"{phase}.out"))
         out[key] = rows[-1] if rows else None
+    for phase, key in (("xent_rows", "xent_chunk_ladder"),
+                       ("long8k", "long_context_8k")):
+        rows = _json_lines(os.path.join(session_dir, f"{phase}.out"))
+        out[key] = rows or None
 
     with os.scandir(session_dir) as it:
         for e in it:
