@@ -15,9 +15,9 @@ from distributed_training_tpu.data.datasets import (  # noqa: F401
     SyntheticRegressionDataset,
     build_dataset,
 )
-from distributed_training_tpu.data.sampler import (  # noqa: F401
-    DistributedShardSampler,
-)
 from distributed_training_tpu.data.loader import (  # noqa: F401
     ShardedDataLoader,
+)
+from distributed_training_tpu.data.sampler import (  # noqa: F401
+    DistributedShardSampler,
 )

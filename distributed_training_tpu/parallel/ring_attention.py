@@ -50,8 +50,8 @@ import functools
 import jax
 import jax.ad_checkpoint
 import jax.numpy as jnp
-from jax.sharding import Mesh, PartitionSpec as P
 from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
 
 from distributed_training_tpu.runtime import AXIS_SP, BATCH_AXES
 

@@ -37,8 +37,8 @@ from __future__ import annotations
 import functools
 
 import jax
-from jax.sharding import Mesh, PartitionSpec as P
 from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
 
 from distributed_training_tpu.ops.attention import dot_product_attention
 from distributed_training_tpu.runtime import AXIS_SP, BATCH_AXES

@@ -8,7 +8,7 @@ collectives, optimizer update — is one jitted SPMD program whose
 parallelism comes from the strategy's sharding layout.
 """
 
-from distributed_training_tpu.train.trainer import Trainer  # noqa: F401
 from distributed_training_tpu.train.optimizer import (  # noqa: F401
     build_optimizer,
 )
+from distributed_training_tpu.train.trainer import Trainer  # noqa: F401
