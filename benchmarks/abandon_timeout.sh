@@ -1,0 +1,32 @@
+#!/bin/bash
+# abandon_timeout.sh SECONDS CMD...
+#
+# Deadline WITHOUT a kill: waits up to SECONDS for CMD; if it is still
+# running, exits 124 LEAVING THE CHILD ALIVE. `timeout -k` SIGKILLs a
+# mid-XLA-compile process, which leaves its PJRT client undestroyed
+# and wedges the accelerator tunnel for ~40 min (the r3/r4 failure
+# mode). An abandoned child instead finishes its compile, banks it in
+# the persistent XLA compilation cache (JAX_COMPILATION_CACHE_DIR),
+# destroys its client cleanly, and the next attempt replays the
+# compile from cache. The caller must treat rc=124 as "window
+# consumed": the orphan still owns the chip, so stop launching TPU
+# work (chip_session.sh breaks on it).
+t=$1; shift
+"$@" &
+pid=$!
+for ((i = 0; i < t; i++)); do
+  if ! kill -0 "$pid" 2>/dev/null; then
+    wait "$pid"
+    exit $?
+  fi
+  sleep 1
+done
+# Final recheck: a child that finished during the last sleep must
+# report its REAL exit status, not a false abandonment (a false 124
+# would stop the whole session with the chip actually free).
+if ! kill -0 "$pid" 2>/dev/null; then
+  wait "$pid"
+  exit $?
+fi
+echo "[abandon] ${t}s deadline reached; leaving pid $pid to finish" >&2
+exit 124

@@ -45,12 +45,46 @@ OUT=benchmarks/state/session_$(date -u +%Y%m%d_%H%M%S)
 mkdir -p "$OUT"
 echo "chip session -> $OUT"
 
+# Always run the CPU-side trace analysis on the way out — including
+# when an abandoned phase ends the session early (exit 124).
+analyze_traces() {
+  for b in 32 48; do
+    if [ -d "$OUT/trace_b$b" ]; then
+      JAX_PLATFORMS=cpu timeout 600 python benchmarks/analyze_trace.py \
+        "$OUT/trace_b$b" --json >"$OUT/analyze_trace_b$b.json" 2>>"$OUT/session.log"
+    fi
+  done
+}
+trap analyze_traces EXIT
+# EXIT traps don't fire on untrapped fatal signals: route INT/TERM
+# through exit so an interrupted session still analyzes its traces.
+trap 'exit 129' INT TERM
+
 phase() {  # phase NAME TIMEOUT_S CMD...
   local name=$1 t=$2; shift 2
   echo "[session] phase=$name start=$(date -u +%H:%M:%S)" | tee -a "$OUT/session.log"
   timeout -k 30 "$t" "$@" >"$OUT/$name.out" 2>"$OUT/$name.log"
   local rc=$?
   echo "[session] phase=$name rc=$rc end=$(date -u +%H:%M:%S)" | tee -a "$OUT/session.log"
+  return $rc
+}
+
+# For phases whose point has never compiled before (fresh big shapes:
+# long-context, the 7B slice): a timeout KILL mid-compile wedges the
+# tunnel (r3/r4), so these run under abandon_timeout.sh — on deadline
+# the child is left to finish and bank the compile in the XLA cache,
+# and the SESSION STOPS (the orphan owns the chip; launching more TPU
+# work would contend on the tunnel and risk a fresh wedge).
+phase_or_stop() {
+  local name=$1 t=$2; shift 2
+  echo "[session] phase=$name start=$(date -u +%H:%M:%S) (abandonable)" | tee -a "$OUT/session.log"
+  bash benchmarks/abandon_timeout.sh "$t" "$@" >"$OUT/$name.out" 2>"$OUT/$name.log"
+  local rc=$?
+  echo "[session] phase=$name rc=$rc end=$(date -u +%H:%M:%S)" | tee -a "$OUT/session.log"
+  if [ "$rc" -eq 124 ]; then
+    echo "[session] ABANDONED $name still compiling; ending session to leave it the chip" | tee -a "$OUT/session.log"
+    exit 124
+  fi
   return $rc
 }
 
@@ -75,19 +109,15 @@ phase trace32 1200 python benchmarks/profile_step.py --batch 32 \
 # windowed points run the SAME tokens/step (4*8192 == 2*16384), so
 # near-equal step times validate O(S*window); the full-causal 8k
 # comparator quantifies the window's saving.
-phase long8k 1800 python benchmarks/tune_headline.py --points \
+phase_or_stop long8k 1800 python benchmarks/tune_headline.py --points \
   '[[4, {"seq_len_override": 8192, "max_seq_len": 8192, "attention_window": 1024}], [4, {"seq_len_override": 8192, "max_seq_len": 8192}]]'
-phase long16k 1800 python benchmarks/tune_headline.py --points \
+phase_or_stop long16k 1800 python benchmarks/tune_headline.py --points \
   '[[2, {"seq_len_override": 16384, "max_seq_len": 16384, "attention_window": 1024}]]'
 phase bench1b 2400 python benchmarks/bench_1b_single_chip.py
-phase slice7b 1800 python benchmarks/tune_headline.py --points \
+phase_or_stop slice7b 1800 python benchmarks/tune_headline.py --points \
   '[[1, {"d_model": 4096, "n_layers": 4, "n_heads": 32, "n_kv_heads": 8, "d_ff": 16384, "max_seq_len": 2048, "seq_len_override": 2048, "pos_encoding": "rope", "tie_embeddings": false, "remat": true, "remat_policy": "mlp"}]]'
 
-# CPU-side trace analysis (forced off-chip).
-for b in 32 48; do
-  if [ -d "$OUT/trace_b$b" ]; then
-    JAX_PLATFORMS=cpu timeout 600 python benchmarks/analyze_trace.py \
-      "$OUT/trace_b$b" --json >"$OUT/analyze_trace_b$b.json" 2>>"$OUT/session.log"
-  fi
-done
+# CPU-side trace analysis (forced off-chip); registered as an EXIT
+# trap above so an abandoned phase ending the session early still
+# analyzes whatever traces were captured.
 echo "[session] done $(date -u +%H:%M:%S)" | tee -a "$OUT/session.log"

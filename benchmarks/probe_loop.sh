@@ -28,7 +28,22 @@ print('OK', d[0].platform)
     # session runs).
     echo "$ts HARVEST_START" >> "$LOG"
     bash /root/repo/benchmarks/chip_session.sh >> "$LOG" 2>&1
-    echo "$(date -u +%H:%M:%S) HARVEST_DONE" >> "$LOG"
+    session_rc=$?
+    echo "$(date -u +%H:%M:%S) HARVEST_DONE rc=$session_rc" >> "$LOG"
+    if [ "$session_rc" -eq 124 ]; then
+      # The session ABANDONED a still-compiling phase and left it the
+      # chip (abandon_timeout.sh). Probing now would contend on the
+      # tunnel and the probe's own timeout-kill is a wedge risk —
+      # wait for the orphan to actually exit (bounded) before the
+      # probe cycle resumes.
+      echo "ORPHAN $(date -u +%H:%M:%S)" > "$STATE"
+      for _ in $(seq 1 120); do
+        pgrep -f "tune_headline.py|bench_1b_single_chip.py|bench.py" \
+          >/dev/null || break
+        sleep 60
+      done
+      echo "$(date -u +%H:%M:%S) ORPHAN_CLEARED" >> "$LOG"
+    fi
   else
     echo "WEDGED $ts rc=$rc" > "$STATE"; echo "$ts WEDGED rc=$rc" >> "$LOG"
   fi
