@@ -706,11 +706,18 @@ def parent_main() -> None:
     if rc is None:
         _phase("deadline_abandon_child", pid=child.pid,
                budget_s=RUN_TIMEOUT_S)
-        _fail("measure_deadline",
-              f"measurement exceeded {RUN_TIMEOUT_S}s; child "
-              f"pid={child.pid} left to finish (a mid-compile kill "
-              "would wedge the accelerator tunnel) — its result, if "
-              "any, lands in the evidence ledger")
+        # rc=124, not 1: the abandoned child still OWNS the chip, and
+        # callers (chip_session.sh phase_or_stop) use 124 as the
+        # "stop launching TPU work" signal — a generic failure rc
+        # would let the session start a second process against the
+        # tunnel the orphan holds.
+        print(json.dumps(_failure_record(
+            "measure_deadline",
+            f"measurement exceeded {RUN_TIMEOUT_S}s; child "
+            f"pid={child.pid} left to finish (a mid-compile kill "
+            "would wedge the accelerator tunnel) — its result, if "
+            "any, lands in the evidence ledger")))
+        sys.exit(124)
     # Propagate the child's own evidence line verbatim when it printed
     # one — on failure it carries the precise stage and the compact
     # last-measured prior (richer than anything the parent could

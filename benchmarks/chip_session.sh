@@ -28,7 +28,9 @@
 #                   causal 8k comparator shows the window's win.
 #   8. bench1b    — 1B single chip (was 0.320 with 256-tile kernels).
 #   9. slice7b    — first measured 7B-width signal (VERDICT 9): a
-#                   4-layer 7B-dim slice, batch 1, S=2048, remat.
+#                   2-layer 7B-dim slice, batch 1, S=2048, remat
+#                   (4 layers is 18 GiB estimated — over the v5e HBM;
+#                   see the phase comment).
 # Known traps, demoted: batch-64 dies in the platform's remote compile
 # helper (HTTP 500); batch-32 no-remat hangs >1800 s in compile — do
 # NOT re-attempt either in an automated window, and never let a phase
@@ -90,8 +92,10 @@ phase_or_stop() {
 
 # 2100: the bench parent self-bounds (probe 480 + child deadline 1500
 # + slack) and ABANDONS a stuck child rather than letting this outer
-# timeout kill anything mid-compile.
-phase headline 2100 python bench.py
+# timeout kill anything mid-compile. phase_or_stop: the parent exits
+# 124 on that abandon path (its orphan still owns the chip), and the
+# session must stop rather than launch a second TPU process.
+phase_or_stop headline 2100 python bench.py
 phase splitbwd 1200 env DTT_FLASH_SPLIT_BWD=1 \
   python benchmarks/tune_headline.py --points '[[32, {}]]'
 phase bhsd_off 1200 env DTT_NO_BHSD=1 \
@@ -114,8 +118,12 @@ phase_or_stop long8k 1800 python benchmarks/tune_headline.py --points \
 phase_or_stop long16k 1800 python benchmarks/tune_headline.py --points \
   '[[2, {"seq_len_override": 16384, "max_seq_len": 16384, "attention_window": 1024}]]'
 phase bench1b 2400 python benchmarks/bench_1b_single_chip.py
+# 2 layers, not 4: estimate_transformer_memory says the 4-layer slice
+# is 18.0 GiB (fp32 params 4.2 + adam moments 8.3) vs the v5e's
+# 16 GiB — 2 layers at production dtypes is 12.3 GiB and fits with
+# headroom. Per-layer step cost extrapolates linearly to 32 layers.
 phase_or_stop slice7b 1800 python benchmarks/tune_headline.py --points \
-  '[[1, {"d_model": 4096, "n_layers": 4, "n_heads": 32, "n_kv_heads": 8, "d_ff": 16384, "max_seq_len": 2048, "seq_len_override": 2048, "pos_encoding": "rope", "tie_embeddings": false, "remat": true, "remat_policy": "mlp"}]]'
+  '[[1, {"d_model": 4096, "n_layers": 2, "n_heads": 32, "n_kv_heads": 8, "d_ff": 16384, "max_seq_len": 2048, "seq_len_override": 2048, "pos_encoding": "rope", "tie_embeddings": false, "remat": true, "remat_policy": "mlp"}]]'
 
 # CPU-side trace analysis (forced off-chip); registered as an EXIT
 # trap above so an abandoned phase ending the session early still

@@ -37,12 +37,22 @@ print('OK', d[0].platform)
       # wait for the orphan to actually exit (bounded) before the
       # probe cycle resumes.
       echo "ORPHAN $(date -u +%H:%M:%S)" > "$STATE"
+      # Anchored to real interpreter invocations: a bare name match
+      # would also hit e.g. an operator's `less tune_headline.py` and
+      # stall probing for hours with the chip actually free.
+      orphan_pat='python [^ ]*(tune_headline|bench_1b_single_chip|bench)\.py'
       for _ in $(seq 1 120); do
-        pgrep -f "tune_headline.py|bench_1b_single_chip.py|bench.py" \
-          >/dev/null || break
+        pgrep -f "$orphan_pat" >/dev/null || break
         sleep 60
       done
-      echo "$(date -u +%H:%M:%S) ORPHAN_CLEARED" >> "$LOG"
+      if pgrep -f "$orphan_pat" >/dev/null; then
+        # Log the truth: the wait capped out with the orphan alive.
+        # Probing resumes (bounded risk, recorded) rather than
+        # stalling forever on what may be a hung process.
+        echo "$(date -u +%H:%M:%S) ORPHAN_TIMEOUT still running" >> "$LOG"
+      else
+        echo "$(date -u +%H:%M:%S) ORPHAN_CLEARED" >> "$LOG"
+      fi
     fi
   else
     echo "WEDGED $ts rc=$rc" > "$STATE"; echo "$ts WEDGED rc=$rc" >> "$LOG"

@@ -515,7 +515,9 @@ def test_parent_abandons_hung_child_without_killing(tmp_path,
         "dtt_abandon_sentinel = 1; import time; time.sleep(8)"])
     with pytest.raises(SystemExit) as ei:
         bench.parent_main()
-    assert ei.value.code == 1
+    # 124, not 1: the orphan still owns the chip, and chip_session's
+    # phase_or_stop keys "stop launching TPU work" off this rc.
+    assert ei.value.code == 124
     rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert rec["error"]["stage"] == "measure_deadline"
     assert "left to finish" in rec["error"]["message"]
