@@ -455,6 +455,13 @@ def test_fsdp_tpu_pipeline_grad_sync_is_reduce_scatter():
                and all(int(d) >= 64 for d in r["shape"].split(","))]
     assert not big_ars, big_ars
 
+    # And the DDP contract on the same real pipeline: gradient
+    # all-reduces are the ONLY collective kind in a DDP step.
+    text = ac.compile_step_hlo(4, "ddp", {"dp": 4},
+                               tpu_topology="v5e:2x2")
+    rep = ac.audit_hlo_text(text)
+    assert set(rep["by_kind"]) == {"all-reduce"}, rep["by_kind"]
+
 
 def _parent_env(monkeypatch, tmp_path):
     import bench
