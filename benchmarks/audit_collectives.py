@@ -149,6 +149,56 @@ def audit_hlo_text(text: str) -> dict:
     }
 
 
+def lower_abstract_step(topology: str, n_devices: int, strategy: str,
+                        model_name: str, model_kwargs: dict,
+                        batch_size: int, seq_len: int,
+                        mesh_axes: dict | None = None,
+                        train_overrides: dict | None = None):
+    """Build the abstract Trainer against a DEVICE-LESS TPU topology
+    and return the Lowered train step (zero materialized state).
+
+    The one shared implementation of the topology-AOT setup — both the
+    collective audit below and benchmarks/precompile_points.py go
+    through it, so the trainer/batch construction cannot drift between
+    the audit and the cache warm-up."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributed_training_tpu.config import Config
+    from distributed_training_tpu.data import (ShardedDataLoader,
+                                               SyntheticLMDataset)
+    from distributed_training_tpu.models import build_model
+    from distributed_training_tpu.runtime import topology_runtime
+    from distributed_training_tpu.train.trainer import Trainer
+
+    cfg = Config()
+    cfg.train.parallel_strategy = strategy
+    cfg.train.batch_size = batch_size
+    cfg.train.log_every = 0
+    for k, v in (train_overrides or {}).items():
+        setattr(cfg.train, k, v)
+    rt = topology_runtime(n_devices, topology, **(mesh_axes or {}))
+    model = build_model(model_name, **model_kwargs)
+    ds = SyntheticLMDataset(
+        size=max(64, batch_size),
+        seq_len=seq_len,
+        vocab_size=min(model.cfg.vocab_size, 50257), seed=0)
+    loader = ShardedDataLoader(ds, rt, batch_size=batch_size,
+                               shuffle=False)
+    trainer = Trainer(cfg, rt, model, loader, abstract=True)
+    sample = ds.batch(np.arange(1))
+    batch = {
+        k: jax.ShapeDtypeStruct(
+            (loader.global_batch,) + v.shape[1:], v.dtype,
+            sharding=trainer.batch_sharding)
+        for k, v in sample.items()}
+    return trainer._step_fn.lower(trainer.state, batch,
+                                  jnp.zeros((2,), jnp.uint32))
+
+
 def compile_step_hlo(n_devices: int, strategy: str,
                      mesh_axes: dict | None = None,
                      model_kwargs: dict | None = None,
@@ -170,9 +220,19 @@ def compile_step_hlo(n_devices: int, strategy: str,
     from distributed_training_tpu.data import (ShardedDataLoader,
                                                SyntheticLMDataset)
     from distributed_training_tpu.models import build_model
-    from distributed_training_tpu.runtime import (fake_cpu_runtime,
-                                                  topology_runtime)
+    from distributed_training_tpu.runtime import fake_cpu_runtime
     from distributed_training_tpu.train.trainer import Trainer
+
+    mk = dict(vocab_size=256, d_model=64, n_layers=2, n_heads=4,
+              max_seq_len=64, dtype="float32")
+    mk.update(model_kwargs or {})
+    if tpu_topology:
+        lowered = lower_abstract_step(
+            tpu_topology, n_devices, strategy, "transformer", mk,
+            batch_size=2 * n_devices, seq_len=32,
+            mesh_axes=mesh_axes,
+            train_overrides=dict(min_shard_elems=1, dtype="float32"))
+        return lowered.compile().as_text()
 
     cfg = Config()
     cfg.train.parallel_strategy = strategy
@@ -180,14 +240,7 @@ def compile_step_hlo(n_devices: int, strategy: str,
     cfg.train.log_every = 0
     cfg.train.min_shard_elems = 1
     cfg.train.dtype = "float32"
-    if tpu_topology:
-        rt = topology_runtime(n_devices, tpu_topology,
-                              **(mesh_axes or {}))
-    else:
-        rt = fake_cpu_runtime(n_devices, **(mesh_axes or {}))
-    mk = dict(vocab_size=256, d_model=64, n_layers=2, n_heads=4,
-              max_seq_len=64, dtype="float32")
-    mk.update(model_kwargs or {})
+    rt = fake_cpu_runtime(n_devices, **(mesh_axes or {}))
     model = build_model("transformer", **mk)
     ds = SyntheticLMDataset(size=max(64, cfg.train.batch_size),
                             seq_len=32, vocab_size=256, seed=0)
@@ -195,20 +248,8 @@ def compile_step_hlo(n_devices: int, strategy: str,
                                shuffle=False)
     import jax.numpy as jnp
 
-    if tpu_topology:
-        # Topology devices hold no data: abstract trainer state and a
-        # ShapeDtypeStruct batch (the loader's global layout).
-        trainer = Trainer(cfg, rt, model, loader, abstract=True)
-        import numpy as np
-        sample = ds.batch(np.arange(1))
-        batch = {
-            k: jax.ShapeDtypeStruct(
-                (loader.global_batch,) + v.shape[1:], v.dtype,
-                sharding=trainer.batch_sharding)
-            for k, v in sample.items()}
-    else:
-        trainer = Trainer(cfg, rt, model, loader)
-        batch = next(iter(loader.epoch(0)))
+    trainer = Trainer(cfg, rt, model, loader)
+    batch = next(iter(loader.epoch(0)))
 
     lowered = trainer._step_fn.lower(trainer.state, batch,
                                      jnp.zeros((2,), jnp.uint32))

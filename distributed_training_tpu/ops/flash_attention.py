@@ -122,6 +122,18 @@ def _apply_causal_mask(s, q_start, k_start, block_q: int, block_k: int,
 
 
 def _platform_is_tpu() -> bool:
+    """True when tracing targets a TPU backend.
+
+    DTT_ASSUME_TPU=1 overrides the attached-device check (read
+    dynamically, not at import: it exists for DEVICE-LESS topology AOT
+    compiles — runtime.topology_runtime — where jax.devices() reports
+    the host CPU even though the program is being compiled by the real
+    TPU compiler; without the override those audits trace the naive
+    path and 0 Pallas kernels reach the compiled HLO). Never set it in
+    a process that will EXECUTE the program on CPU: the kernels would
+    run in compiled (non-interpret) mode on a backend without Mosaic."""
+    if os.environ.get("DTT_ASSUME_TPU", "0") not in ("", "0"):
+        return True
     try:
         return jax.devices()[0].platform == "tpu"
     except RuntimeError:  # pragma: no cover
