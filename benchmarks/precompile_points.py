@@ -55,11 +55,17 @@ POINTS = [
      dict(d_model=4096, n_layers=2, n_heads=32, n_kv_heads=8,
           d_ff=16384, max_seq_len=2048, pos_encoding="rope",
           tie_embeddings=False, remat=True, remat_policy="mlp")),
+    # bench_1b_single_chip.py's primary config (batch 1, adafactor,
+    # full remat) — its compile is the big fixed cost of the bench1b
+    # session phase.
+    ("bench1b_s1024", 1, 1024, "transformer_1b",
+     dict(remat=True, remat_policy="full"),
+     dict(optimizer="adafactor")),
 ]
 
 
 def compile_point(name, batch, seq_len, model_name, model_kwargs,
-                  topology="v5e:2x2"):
+                  train_overrides=None, topology="v5e:2x2"):
     """Compile one bench-style point via the shared topology-AOT
     builder (audit_collectives.lower_abstract_step — the one
     implementation, so this cannot drift from the audit's)."""
@@ -69,8 +75,9 @@ def compile_point(name, batch, seq_len, model_name, model_kwargs,
         topology, 1, "ddp", model_name,
         {"dtype": "bfloat16", **model_kwargs},
         batch_size=batch, seq_len=seq_len,
-        train_overrides=dict(optimizer="adamw", learning_rate=6e-4,
-                             dtype="bfloat16"))
+        train_overrides={**dict(optimizer="adamw", learning_rate=6e-4,
+                                dtype="bfloat16"),
+                         **(train_overrides or {})})
     t0 = time.time()
     compiled = lowered.compile()
     dt = time.time() - t0
