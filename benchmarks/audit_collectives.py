@@ -202,7 +202,8 @@ def lower_abstract_step(topology: str, n_devices: int, strategy: str,
 def compile_step_hlo(n_devices: int, strategy: str,
                      mesh_axes: dict | None = None,
                      model_kwargs: dict | None = None,
-                     tpu_topology: str | None = None) -> str:
+                     tpu_topology: str | None = None,
+                     seq_len: int = 32) -> str:
     """Build the real Trainer on a virtual mesh and return the
     compiled (SPMD-partitioned) HLO of its jitted train step.
 
@@ -229,7 +230,7 @@ def compile_step_hlo(n_devices: int, strategy: str,
     if tpu_topology:
         lowered = lower_abstract_step(
             tpu_topology, n_devices, strategy, "transformer", mk,
-            batch_size=2 * n_devices, seq_len=32,
+            batch_size=2 * n_devices, seq_len=seq_len,
             mesh_axes=mesh_axes,
             train_overrides=dict(min_shard_elems=1, dtype="float32"))
         return lowered.compile().as_text()
@@ -243,7 +244,7 @@ def compile_step_hlo(n_devices: int, strategy: str,
     rt = fake_cpu_runtime(n_devices, **(mesh_axes or {}))
     model = build_model("transformer", **mk)
     ds = SyntheticLMDataset(size=max(64, cfg.train.batch_size),
-                            seq_len=32, vocab_size=256, seed=0)
+                            seq_len=seq_len, vocab_size=256, seed=0)
     loader = ShardedDataLoader(ds, rt, batch_size=cfg.train.batch_size,
                                shuffle=False)
     import jax.numpy as jnp

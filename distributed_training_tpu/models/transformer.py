@@ -361,7 +361,14 @@ class Transformer:
         impl = c.attention_impl
         if impl == "naive":
             return False
-        if impl in ("flash", "ring"):
+        if impl == "ring":
+            return True
+        if impl in ("auto", "flash") and not self._tp_head_shardable():
+            # Heads don't divide tp: the per-shard kernel cannot run
+            # on a fractional head, so _attention demotes to naive —
+            # the allow-lists must save attn_out accordingly.
+            return False
+        if impl == "flash":
             return True
         # 'auto' (single-device) and 'ulysses' (local attention over
         # the full sequence after the a2a; head counts shrink by
@@ -393,6 +400,56 @@ class Transformer:
         return (not _NO_BHSD
                 and self.cfg.attention_impl in ("auto", "flash")
                 and self._flash_active(seq_len))
+
+    def _active_batch_axes(self) -> tuple:
+        """Mesh batch axes with size > 1 (the data axes activations
+        are actually sharded over) — single source for the pin
+        constraint and the flash shard_map in_specs, which MUST agree
+        (a mismatch is only caught by a topology compile)."""
+        if self.mesh is None:
+            return ()
+        from distributed_training_tpu.runtime import BATCH_AXES
+        sizes = self._mesh_axis_sizes()
+        return tuple(a for a in BATCH_AXES if sizes.get(a, 1) > 1)
+
+    def _tp_head_shardable(self) -> bool:
+        """Can the flash kernel take a tp head shard? False when a
+        bound mesh has tp > 1 that does not divide the (kv) head
+        counts — the per-shard kernel cannot run on a fractional head,
+        so dispatch demotes to naive and the remat allow-lists must
+        save attn_out, not the flash residual names (the two MUST stay
+        in sync: saving names that never exist makes the backward
+        silently recompute all attention, the r4 31.8 ms/step bug
+        class). Inside the pipeline's shard_map stage params are
+        replicated over tp, so heads arrive whole."""
+        if self.mesh is None or self._inside_pp:
+            return True
+        from distributed_training_tpu.runtime import AXIS_TP
+        tp = self._mesh_axis_sizes().get(AXIS_TP, 1)
+        if tp <= 1:
+            return True
+        c = self.cfg
+        return not (c.n_heads % tp or (c.n_kv_heads or c.n_heads) % tp)
+
+    def _pin_batch(self, x: jax.Array) -> jax.Array:
+        """Constrain x's leading (batch) dim to the data axes; other
+        dims unconstrained (sp layouts keep their sequence sharding).
+        Applied OUTSIDE the jax.checkpoint boundary in the layer scan:
+        the residual jax.checkpoint saves is its INPUT, and without
+        the pin, sharding propagation through scan + the attention
+        shard_map left the stacked per-layer residuals REPLICATED —
+        at 7B/fsdp=16 an 8 GB bf16[L, B_global, S, D] buffer per
+        device (caught by the device-less topology compile)."""
+        if self.mesh is None or self._inside_pp:
+            return x
+        b_axes = self._active_batch_axes()
+        if not b_axes:
+            return x
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        U = P.UNCONSTRAINED
+        return jax.lax.with_sharding_constraint(
+            x, NamedSharding(self.mesh,
+                             P(b_axes, *([U] * (x.ndim - 1)))))
 
     def _attention(self, q, k, v, layout: str = "bshd"):
         c = self.cfg
@@ -495,8 +552,53 @@ class Transformer:
                                      block_k=c.flash_block_k,
                                      window=window)
             return fn(q, k, v)
+        # Per-shard flash under a bound multi-device mesh must run
+        # inside shard_map: the SPMD partitioner cannot partition a
+        # Mosaic custom call ("Mosaic kernels cannot be automatically
+        # partitioned"), so the plain-jit path that works single-chip
+        # FAILS TO COMPILE on a real pod with dp/fsdp/tp > 1 — caught
+        # by the device-less 7B fsdp=16 topology compile (the CPU
+        # dryrun masked it: off-TPU the dispatch demotes to naive,
+        # which the partitioner handles). Inside the pipeline's
+        # shard_map every axis is already manual, so the direct call
+        # is correct there.
+        if (self.mesh is not None and not self._inside_pp
+                and c.attention_impl in ("auto", "flash")
+                and self._flash_active(S_total)):
+            # _flash_active already returned False for the
+            # tp-indivisible case (see _tp_head_shardable) — here the
+            # kernel is definitely running, so wrap it in shard_map.
+            from distributed_training_tpu.runtime import AXIS_TP
+            sizes = self._mesh_axis_sizes()
+            b_axes = self._active_batch_axes()
+            head_ax = AXIS_TP if sizes.get(AXIS_TP, 1) > 1 else None
+            if b_axes or head_ax:
+                from jax.experimental.shard_map import shard_map
+                from jax.sharding import PartitionSpec as P
+                if layout == "bhsd":
+                    spec = P(b_axes or None, head_ax, None, None)
+                else:
+                    spec = P(b_axes or None, None, head_ax, None)
+                fn = shard_map(
+                    functools.partial(
+                        dot_product_attention, causal=True,
+                        impl=c.attention_impl,
+                        block_q=c.flash_block_q,
+                        block_k=c.flash_block_k,
+                        window=window, layout=layout),
+                    mesh=self.mesh, in_specs=(spec, spec, spec),
+                    out_specs=spec, check_rep=False)
+                return fn(q, k, v)
+        impl = c.attention_impl
+        if impl in ("auto", "flash") and not self._tp_head_shardable():
+            # The kernel can't take a fractional tp head shard — run
+            # the naive path, which the partitioner handles with
+            # collectives (correct, slower; ring attention is the
+            # fast option for such head counts). Matches
+            # _flash_active, so the remat allow-lists save attn_out.
+            impl = "naive"
         return dot_product_attention(q, k, v, causal=True,
-                                     impl=c.attention_impl,
+                                     impl=impl,
                                      block_q=c.flash_block_q,
                                      block_k=c.flash_block_k,
                                      window=window, layout=layout)
@@ -604,6 +706,7 @@ class Transformer:
         dt = x.dtype
         drop = (functools.partial(_dropout, rate=c.dropout)
                 if dropout_rng is not None else None)
+
 
         # checkpoint_name tags drive the remat policies (allow-list
         # semantics — save_only_these_names; the "anything except"
@@ -844,8 +947,16 @@ class Transformer:
                     policy = None
                 block = jax.checkpoint(block, prevent_cse=False,
                                        policy=policy)
+
+            def pinned_block(carry, inp, _block=block):
+                # Batch-pin OUTSIDE the checkpoint boundary so the
+                # residual jax.checkpoint saves (its input) is the
+                # batch-sharded value — see _pin_batch.
+                xc, acc = carry
+                return _block((self._pin_batch(xc), acc), inp)
+
             (x, aux), _ = jax.lax.scan(
-                block, (x, jnp.zeros((), jnp.float32)),
+                pinned_block, (x, jnp.zeros((), jnp.float32)),
                 (stacked, layer_ids_all), unroll=c.scan_unroll)
         aux = aux / c.n_layers  # mean load-balancing loss over layers
 

@@ -39,16 +39,22 @@ def state_specs(strategy: ShardingStrategy,
     if opt_shapes is None:
         opt_shapes = jax.eval_shape(optimizer.init, param_shapes)
 
-    def spec_for_opt_leaf(leaf, spec):
-        # Optimizer state that is not param-shaped cannot inherit the
-        # param's spec: Adafactor's factored v_row/v_col are lower
-        # rank, and its shape-(1,) placeholders (for non-factored
-        # params) are rank-1 but size-1 — partitioning either is
-        # nonsense. Replicate both; they are tiny by construction.
-        if isinstance(spec, P) and hasattr(leaf, "ndim"):
-            size = int(np.prod(leaf.shape)) if leaf.ndim else 1
-            if len(spec) > leaf.ndim or size <= 1:
-                return P()
+    def spec_for_opt_leaf(leaf, spec, pshape):
+        # Optimizer state inherits the param's spec ONLY when it is
+        # exactly param-shaped. Anything else replicates: Adafactor's
+        # factored v_row/v_col drop one of the param's dims, so a
+        # rank-compatible spec can still land a sharded axis on the
+        # WRONG (possibly non-divisible) dimension — caught by the 7B
+        # fsdp=16 topology compile, where GQA wk (L, D, Hkv, hd) has
+        # param spec P(None, 'fsdp') but v_row is (L, Hkv, hd) and
+        # dim 1 became Hkv=8, not divisible by 16. (The earlier
+        # rank/size guard missed exactly this equal-rank-prefix case.)
+        # Factored moments are tiny by construction, so replication
+        # costs nothing material.
+        if (isinstance(spec, P) and hasattr(leaf, "shape")
+                and hasattr(pshape, "shape")
+                and tuple(leaf.shape) != tuple(pshape.shape)):
+            return P()
         return spec
 
     opt_specs = optax.tree_map_params(
@@ -56,6 +62,7 @@ def state_specs(strategy: ShardingStrategy,
         spec_for_opt_leaf,
         opt_shapes,
         opt_base_specs,
+        param_shapes,
         transform_non_params=lambda _leaf: P(),
         is_leaf=lambda x: isinstance(x, jax.ShapeDtypeStruct),
     )

@@ -463,6 +463,34 @@ def test_fsdp_tpu_pipeline_grad_sync_is_reduce_scatter():
     assert set(rep["by_kind"]) == {"all-reduce"}, rep["by_kind"]
 
 
+def test_multidevice_flash_compiles_under_tpu_compiler(monkeypatch):
+    """Regression pin for a bug only the real TPU pipeline can see:
+    the SPMD partitioner cannot partition Mosaic custom calls, so the
+    plain-jit flash path that works single-chip FAILED to compile on
+    any multi-device mesh ('Mosaic kernels cannot be automatically
+    partitioned') — masked on CPU dryruns, where dispatch demotes to
+    naive. The model now wraps per-shard flash in shard_map over the
+    data (and tp head) axes; this compiles the audit model on fsdp=4
+    with the kernels ACTIVE (DTT_ASSUME_TPU=1) and asserts Pallas
+    calls are present in the partitioned program."""
+    import audit_collectives as ac
+
+    monkeypatch.setenv("DTT_ASSUME_TPU", "1")
+    try:
+        from distributed_training_tpu.runtime import topology_runtime
+        topology_runtime(4, "v5e:2x2")
+    except Exception as e:  # pragma: no cover - no libtpu
+        pytest.skip(f"device-less TPU topology unavailable: {e}")
+    # S=256 so the flash kernels are tile-eligible (supported() wants
+    # S >= 128); the audit default S=32 would demote to naive and
+    # prove nothing.
+    text = ac.compile_step_hlo(
+        4, "fsdp", {"fsdp": 4},
+        {"max_seq_len": 256, "tie_embeddings": False},
+        tpu_topology="v5e:2x2", seq_len=256)
+    assert 'custom_call_target="tpu_custom_call"' in text
+
+
 def test_headline_kernels_compile_under_tpu_compiler(monkeypatch):
     """The Pallas flash kernels (seq-aware 1024x1024 tiles, fused
     single-sweep backward) must compile under the REAL TPU compiler —

@@ -102,3 +102,62 @@ def test_memory_estimator_knows_adafactor():
     ada = memory.estimate_transformer_memory(c, 1, 32,
                                              optimizer="adafactor")
     assert 0 < ada.opt_gib < adam.opt_gib / 10
+
+
+def test_factored_moment_specs_never_inherit_mismatched_param_spec():
+    """Regression pin for the 7B fsdp=16 topology-compile failure:
+    GQA wk is (L, D, Hkv, hd) with param spec P(None, 'fsdp') (the
+    strategy truncates trailing Nones), but adafactor's factored
+    v_row drops a middle dim — inheriting the spec landed 'fsdp' on
+    Hkv=8, not divisible by 16. Optimizer state may inherit the
+    param's spec ONLY when it is exactly param-shaped; everything
+    else replicates."""
+    from distributed_training_tpu.parallel import get_strategy
+    from distributed_training_tpu.runtime import fake_cpu_runtime
+    from distributed_training_tpu.train import state as state_lib
+    from distributed_training_tpu.train.optimizer import build_optimizer
+
+    rt = fake_cpu_runtime(8, fsdp=8)
+    strategy = get_strategy("fsdp", rt.spec, min_shard_elems=1)
+    model = Transformer(TransformerConfig(
+        vocab_size=256, d_model=64, n_layers=2, n_heads=8,
+        n_kv_heads=2, max_seq_len=64, dtype="float32",
+        pos_encoding="rope", tie_embeddings=False))
+    cfg = Config()
+    cfg.train.optimizer = "adafactor"
+    optimizer = build_optimizer(cfg.train, 10)
+    p_shapes = jax.eval_shape(model.init, jax.random.PRNGKey(0))
+    specs = state_lib.state_specs(
+        strategy, optimizer, p_shapes,
+        model.logical_axes() if hasattr(model, "logical_axes")
+        else None)
+    from jax.sharding import PartitionSpec as P
+
+    def walk(tree, path=()):
+        if isinstance(tree, dict):
+            for k, v in tree.items():
+                yield from walk(v, path + (k,))
+        elif hasattr(tree, "_fields"):  # NamedTuple states (tuple
+            # subclasses — must be checked BEFORE the tuple branch)
+            for k in tree._fields:
+                yield from walk(getattr(tree, k), path + (k,))
+        elif isinstance(tree, (list, tuple)):
+            for i, v in enumerate(tree):
+                yield from walk(v, path + (i,))
+        else:
+            yield path, tree
+
+    o_shapes = jax.eval_shape(optimizer.init, p_shapes)
+    shape_by_path = dict(walk(o_shapes))
+    p_shape_leaves = {tuple(s.shape)
+                      for _, s in walk(p_shapes)
+                      if hasattr(s, "shape")}
+    checked = 0
+    for path, spec in walk(specs["opt_state"]):
+        leaf = shape_by_path.get(path)
+        if leaf is None or not hasattr(leaf, "shape"):
+            continue
+        if tuple(leaf.shape) not in p_shape_leaves:
+            assert spec == P(), (path, leaf.shape, spec)
+            checked += 1
+    assert checked > 0  # factored moments existed and were checked
