@@ -303,3 +303,37 @@ def test_xent_chunk_rows_knob_is_loss_invariant():
         losses.append(float(m.loss(
             p, {"tokens": tokens}, jax.random.PRNGKey(1))[0]))
     assert losses[0] == pytest.approx(losses[1], rel=1e-6)
+
+
+def test_tp_indivisible_heads_demote_consistently():
+    """When a bound mesh's tp does not divide the (kv) head counts,
+    the flash kernel cannot take a head shard: dispatch demotes to
+    naive AND _flash_active reports False, so the remat allow-lists
+    save attn_out (which exists) rather than the flash residual names
+    (which don't — saving the wrong set makes the backward silently
+    recompute all attention, the r4 31.8 ms/step bug class)."""
+    from distributed_training_tpu.runtime import fake_cpu_runtime
+
+    rt = fake_cpu_runtime(8, tp=4, dp=2)
+    model = Transformer(TransformerConfig(
+        vocab_size=64, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        max_seq_len=256, dtype="float32", attention_impl="flash",
+        remat=True, remat_policy="mlp"))
+    model.bind_mesh(rt.mesh)
+    # n_kv_heads=2 not divisible by tp=4 -> not shardable -> inactive.
+    assert not model._tp_head_shardable()
+    assert not model._flash_active(256)
+    # The step still runs (naive path through the partitioner).
+    params = model.init(jax.random.PRNGKey(0))
+    tokens = jnp.zeros((8, 33), jnp.int32)
+    loss, _ = jax.jit(lambda p, t: model.loss(
+        p, {"tokens": t}, jax.random.PRNGKey(1)))(params, tokens)
+    assert np.isfinite(float(loss))
+    # Divisible heads stay shardable/active (impl='flash' forces the
+    # kernel; on this CPU host supported() would be False for 'auto').
+    model2 = Transformer(TransformerConfig(
+        vocab_size=64, d_model=64, n_layers=2, n_heads=4, n_kv_heads=4,
+        max_seq_len=256, dtype="float32", attention_impl="flash"))
+    model2.bind_mesh(rt.mesh)
+    assert model2._tp_head_shardable()
+    assert model2._flash_active(256)
