@@ -102,7 +102,10 @@ phase bhsd_off 1200 env DTT_NO_BHSD=1 \
   python benchmarks/tune_headline.py --points '[[32, {}]]'
 phase xent_rows 1500 python benchmarks/tune_headline.py --points \
   '[[32, {"xent_chunk_rows": 512}], [32, {"xent_chunk_rows": 8192}]]'
-phase batch48 1200 python benchmarks/tune_headline.py --points '[[48, {}]]'
+# 40 rides along: the compile-level memory ladder (10.76 GiB @32,
+# 15.74 @48 on a 16 GiB chip) says 48's regression is allocator
+# pressure — 40 (~13 GiB) probes whether there is headroom above 32.
+phase batch48 1800 python benchmarks/tune_headline.py --points '[[48, {}], [40, {}]]'
 phase trace48 1200 python benchmarks/profile_step.py --batch 48 \
   --model-kwargs '{"remat": true, "remat_policy": "mlp"}' \
   --trace "$OUT/trace_b48"
