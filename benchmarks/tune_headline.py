@@ -28,20 +28,24 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
 from bench import run_sweep_point  # noqa: E402  (repo-root bench.py)
 
 # (batch, model_kwargs): ordered cheap-to-expensive so early failures
-# still leave the high-value points measured.
+# still leave the high-value points measured. The batch-64 points
+# were REMOVED after r4 measured the trap: the platform's remote
+# compile helper dies on them (HTTP 500), burning a salvage window
+# per attempt — and the compile-level memory ladder (r5 precompile
+# evidence: 10.76 GiB @32, 13.3 @40, 15.74 @48 on a 16 GiB chip)
+# says the batch ceiling is under 48 anyway; 40 is the remaining
+# open probe above the 0.427 point.
 MATRIX = [
     # r2 configuration reproduced — the comparison anchor.
     (8, {"remat": False}),
     # the mlp-remat batch ladder (the expected winner region).
     (16, {}),
     (32, {}),
+    (40, {}),
     (48, {}),
-    (64, {}),
     # knob variants at the ladder's center.
     (32, {"scan_unroll": 4}),
     (32, {"flash_block_q": 512, "flash_block_k": 512}),
-    # selective remat trades +33% recompute for the biggest batches.
-    (64, {"remat_policy": "selective"}),
     # seq-length variant at constant tokens/step: if tok/s moves, the
     # limiter depends on the (B, S) layout, not just token count.
     (16, {"seq_len_override": 2048}),
@@ -56,12 +60,13 @@ UNROLL_MATRIX = [
     (16, {"remat": False, "scan_unroll": 12}),
 ]
 # The highest-information points for a short healthy-chip window:
-# r2 anchor, the headline candidate, and the batch ceiling probes.
+# r2 anchor, the headline candidate, and the open batch probe (64
+# dropped — the measured HTTP-500 remote-compile trap, see MATRIX).
 QUICK = [
     (8, {"remat": False}),
     (32, {}),
+    (40, {}),
     (48, {}),
-    (64, {}),
 ]
 
 
