@@ -272,7 +272,7 @@ def test_tune_headline_matrix_plumbing(monkeypatch, capsys):
     def fake_measure(batch, seq_len=1024, timed_steps=10,
                      warmup_steps=2, phase=None, **kw):
         seen.append((batch, dict(kw)))
-        if batch == 64:  # the ceiling probe fake-OOMs
+        if batch == 48:  # the ceiling probe fake-OOMs
             raise RuntimeError("RESOURCE_EXHAUSTED: fake OOM")
         return {"mfu": 0.3, "batch": batch, "loss_finite": True,
                 "model_kwargs": kw}
@@ -285,10 +285,10 @@ def test_tune_headline_matrix_plumbing(monkeypatch, capsys):
     assert len(rows) == len(tune_headline.QUICK)
     assert len(seen) == len(tune_headline.QUICK)
     errors = [r for r in rows if "error" in r]
-    # The batch-64 ceiling probe fake-OOMs; its error row carries the
+    # The batch-48 ceiling probe fake-OOMs; its error row carries the
     # merged kwargs so sweep analysis sees what actually ran.
     assert len(errors) == 1
-    assert errors[0]["batch"] == 64
+    assert errors[0]["batch"] == 48
     assert "remat_policy" in errors[0]["model_kwargs"]  # merged headline
     assert all("point_wall_s" in r for r in rows)
 
