@@ -1,0 +1,66 @@
+#!/bin/bash
+# Continuation of a chip_session.sh window whose bhsd_off phase hung
+# in the platform's remote compile (>17 min RPC-blocked at zero client
+# CPU — the batch-32-no-remat hang class, 2026-08-02). Runs the
+# REMAINING phases only (headline/splitbwd already measured: 0.4392
+# fused vs 0.4168 split), every phase under the abandon protocol —
+# a deadline never kills a possibly-compiling child; it leaves the
+# orphan the chip and stops the session (rc=124).
+#
+# New vs chip_session.sh: the mlp_pre point — remat_policy="mlp_pre"
+# saves the pre-gelu tensor and eliminates the wi-matmul recompute
+# (~8% of step FLOPs at the headline shape; estimator says 13.0 GiB,
+# inside the measured-fine batch-48 envelope of 15.74).
+set -u
+cd /root/repo
+export PYTHONPATH=/root/repo:/root/.axon_site
+export DTT_BENCH_NO_CLAIM=1
+export JAX_COMPILATION_CACHE_DIR=/root/repo/benchmarks/state/xla_cache
+OUT=${1:?usage: session_continue.sh OUTDIR}
+mkdir -p "$OUT"
+echo "session continuation -> $OUT"
+
+analyze_traces() {
+  for b in 32 48; do
+    if [ -d "$OUT/trace_b$b" ]; then
+      JAX_PLATFORMS=cpu timeout 600 python benchmarks/analyze_trace.py \
+        "$OUT/trace_b$b" --json >"$OUT/analyze_trace_b$b.json" 2>>"$OUT/session.log"
+    fi
+  done
+}
+trap analyze_traces EXIT
+trap 'exit 129' INT TERM
+
+phase_or_stop() {
+  local name=$1 t=$2; shift 2
+  echo "[session] phase=$name start=$(date -u +%H:%M:%S) (abandonable)" | tee -a "$OUT/session.log"
+  bash benchmarks/abandon_timeout.sh "$t" "$@" >"$OUT/$name.out" 2>"$OUT/$name.log"
+  local rc=$?
+  echo "[session] phase=$name rc=$rc end=$(date -u +%H:%M:%S)" | tee -a "$OUT/session.log"
+  if [ "$rc" -eq 124 ]; then
+    echo "[session] ABANDONED $name still compiling; ending session to leave it the chip" | tee -a "$OUT/session.log"
+    exit 124
+  fi
+  return $rc
+}
+
+phase_or_stop mlp_pre 1500 python benchmarks/tune_headline.py --points \
+  '[[32, {"remat_policy": "mlp_pre"}]]'
+phase_or_stop xent_rows 1500 python benchmarks/tune_headline.py --points \
+  '[[32, {"xent_chunk_rows": 512}], [32, {"xent_chunk_rows": 8192}]]'
+phase_or_stop batch48 1800 python benchmarks/tune_headline.py --points '[[48, {}], [40, {}]]'
+phase_or_stop trace48 1200 python benchmarks/profile_step.py --batch 48 \
+  --model-kwargs '{"remat": true, "remat_policy": "mlp"}' \
+  --trace "$OUT/trace_b48"
+phase_or_stop trace32 1200 python benchmarks/profile_step.py --batch 32 \
+  --model-kwargs '{"remat": true, "remat_policy": "mlp"}' \
+  --trace "$OUT/trace_b32"
+phase_or_stop long8k 1800 python benchmarks/tune_headline.py --points \
+  '[[4, {"seq_len_override": 8192, "max_seq_len": 8192, "attention_window": 1024}], [4, {"seq_len_override": 8192, "max_seq_len": 8192}]]'
+phase_or_stop long16k 1800 python benchmarks/tune_headline.py --points \
+  '[[2, {"seq_len_override": 16384, "max_seq_len": 16384, "attention_window": 1024}]]'
+phase_or_stop bench1b 2400 python benchmarks/bench_1b_single_chip.py
+phase_or_stop slice7b 1800 python benchmarks/tune_headline.py --points \
+  '[[1, {"d_model": 4096, "n_layers": 2, "n_heads": 32, "n_kv_heads": 8, "d_ff": 16384, "max_seq_len": 2048, "seq_len_override": 2048, "pos_encoding": "rope", "tie_embeddings": false, "remat": true, "remat_policy": "mlp"}]]'
+
+echo "[session] done $(date -u +%H:%M:%S)" | tee -a "$OUT/session.log"

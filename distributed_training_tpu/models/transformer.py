@@ -65,7 +65,7 @@ class TransformerConfig:
     # buffers at B=16, the whole OOM). Backward recompute = wi-matmul
     # + gelu (~+11% of fwd FLOPs) — the cheapest policy that unlocks
     # large batches.
-    remat_policy: str = "selective"  # "full" | "selective" | "mlp"
+    remat_policy: str = "selective"  # "full"|"selective"|"mlp"|"mlp_pre"
     attention_impl: str = "auto"
     # Sliding-window (Mistral-style) attention: query i attends keys
     # in [i − window + 1, i]. 0 = full causal. Flash kernels skip
@@ -149,13 +149,14 @@ class TransformerConfig:
             raise ValueError(
                 f"scan_unroll ({self.scan_unroll}) must be >= 1 and "
                 f"divide n_layers ({self.n_layers})")
-        if self.remat_policy not in ("full", "selective", "mlp"):
+        if self.remat_policy not in ("full", "selective", "mlp",
+                                     "mlp_pre"):
             # Validate here (not only in the remat branch of apply) so
             # a typo surfaces at construction even with remat=False or
             # on pp>1 meshes that bypass the single-stack remat path.
             raise ValueError(
                 f"unknown remat_policy '{self.remat_policy}' "
-                "(expected 'full', 'selective' or 'mlp')")
+                "(expected 'full', 'selective', 'mlp' or 'mlp_pre')")
 
     @property
     def head_dim(self) -> int:
@@ -174,6 +175,15 @@ FLASH_RESIDUAL_NAMES = ("flash_out", "flash_lse")
 MLP_POLICY_SAVED = ("ln1_out", "q_rope", "k_rope", "v_proj",
                     "attn_out", "resid_attn", "ln2_out",
                     *FLASH_RESIDUAL_NAMES)
+# remat_policy="mlp_pre" additionally saves the ONE F-wide pre-gelu
+# tensor, eliminating the wi-matmul recompute that "mlp" pays every
+# backward (2*B*S*D*F FLOPs/layer ~ 8% of the step at gpt2_125m
+# shapes); the only remaining recompute is the elementwise gelu, whose
+# VJP input the saved pre-activation provides directly. HBM cost:
+# B*S*F*2 bytes/layer (192 MiB at batch 32, gpt2_125m) — the
+# compile-level memory ladder (10.76 GiB @32 with "mlp" on a 16 GiB
+# v5e) says it fits; "mlp" remains the default for tighter configs.
+MLP_PRE_POLICY_SAVED = (*MLP_POLICY_SAVED, "mlp_pre")
 
 # DTT_NO_BHSD=1 keeps attention in the BSHD einsum layout (disables
 # the _bhsd_fast path) — the chip session A/Bs the layout fast path on
@@ -755,12 +765,17 @@ class Transformer:
             mlp_out, aux = _moe_mlp(h, layer["mlp"], c, w=self._w)
         else:
             m = layer["mlp"]
-            # The two (B, S, 4D) tensors here are deliberately
-            # UN-named: under the "mlp" policy's allow-list they are
-            # the only recompute (wi-matmul + gelu in backward).
+            # Under the "mlp" policy's allow-list the two (B, S, 4D)
+            # tensors here are the only recompute (wi-matmul + gelu in
+            # backward); "mlp_pre" saves the tagged pre-gelu one and
+            # recomputes just the elementwise gelu.
             u = jnp.einsum(
                 "bsd,df->bsf", h, self._w(m["wi"], dt, "mlp/wi")
             ) + m["bi"].astype(dt)
+            # Tag is a no-op unless the active policy allow-lists it
+            # ("mlp_pre"); under "mlp" both (B, S, 4D) tensors stay
+            # un-named and are the policy's deliberate recompute.
+            u = name(u, "mlp_pre")
             u = jax.nn.gelu(u)
             mlp_out = jnp.einsum(
                 "bsf,fd->bsd", u, self._w(m["wo"], dt, "mlp/wo")
@@ -936,9 +951,18 @@ class Transformer:
                 if c.remat_policy == "selective":
                     policy = (jax.checkpoint_policies
                               .save_only_these_names(*attn_names))
-                elif c.remat_policy == "mlp":
+                elif c.remat_policy in ("mlp", "mlp_pre"):
+                    # The "mlp_pre" tag exists only in the dense MLP
+                    # branch; with MoE active the policy degrades to
+                    # "mlp" (an unmatched allow-list name is a silent
+                    # no-op — keep the estimator in utils/memory.py in
+                    # agreement).
+                    base = (MLP_PRE_POLICY_SAVED
+                            if (c.remat_policy == "mlp_pre"
+                                and c.moe_num_experts == 0)
+                            else MLP_POLICY_SAVED)
                     saved = tuple(
-                        n for n in MLP_POLICY_SAVED
+                        n for n in base
                         if n not in ("attn_out", *FLASH_RESIDUAL_NAMES)
                     ) + attn_names
                     policy = (jax.checkpoint_policies

@@ -132,6 +132,13 @@ def estimate_transformer_memory(
         act_per_layer = 3 * D * B * S * ab
     elif c.remat_policy == "mlp":
         act_per_layer = 8 * D * B * S * ab
+    elif c.remat_policy == "mlp_pre":
+        # "mlp" saves + the one F-wide pre-gelu tensor. The tag only
+        # exists in the dense MLP branch: with MoE active the policy
+        # degrades to "mlp" (transformer.py policy selection) and the
+        # F-wide save must not be charged.
+        moe = getattr(c, "moe_num_experts", 0)
+        act_per_layer = (8 * D + (F if not moe else 0)) * B * S * ab
     else:  # full
         act_per_layer = 2 * D * B * S * ab
     acts_b = c.n_layers * act_per_layer * _SCAN_RESIDUAL_OVERHEAD
