@@ -27,7 +27,11 @@ print('OK', d[0].platform)
     # (never two TPU processes — probing pauses while the sequential
     # session runs).
     echo "$ts HARVEST_START" >> "$LOG"
-    bash /root/repo/benchmarks/chip_session.sh >> "$LOG" 2>&1
+    # session_continue.sh, not chip_session.sh: the 2026-08-02 window
+    # already measured headline+splitbwd; the continuation is
+    # RESUMABLE (skips measured phases), so repeated short health
+    # windows each harvest the next phases.
+    bash /root/repo/benchmarks/session_continue.sh >> "$LOG" 2>&1
     session_rc=$?
     echo "$(date -u +%H:%M:%S) HARVEST_DONE rc=$session_rc" >> "$LOG"
     if [ "$session_rc" -eq 124 ]; then
@@ -40,7 +44,7 @@ print('OK', d[0].platform)
       # Anchored to real interpreter invocations: a bare name match
       # would also hit e.g. an operator's `less tune_headline.py` and
       # stall probing for hours with the chip actually free.
-      orphan_pat='python [^ ]*(tune_headline|bench_1b_single_chip|bench)\.py'
+      orphan_pat='python [^ ]*(tune_headline|bench_1b_single_chip|bench|profile_step)\.py'
       for _ in $(seq 1 120); do
         pgrep -f "$orphan_pat" >/dev/null || break
         sleep 60

@@ -1,28 +1,33 @@
 #!/bin/bash
-# Continuation of a chip_session.sh window whose bhsd_off phase hung
-# in the platform's remote compile (>17 min RPC-blocked at zero client
-# CPU — the batch-32-no-remat hang class, 2026-08-02). Runs the
-# REMAINING phases only (headline/splitbwd already measured: 0.4392
-# fused vs 0.4168 split), every phase under the abandon protocol —
-# a deadline never kills a possibly-compiling child; it leaves the
+# Continuation of the 2026-08-02 chip window: headline (0.4392 MFU,
+# fused bwd) and splitbwd (0.4168) were measured before the tunnel
+# went sick mid-window — the bhsd_off phase's backend init blocked
+# 25 min and returned UNAVAILABLE. This script runs the REMAINING
+# phases, is fired by probe_loop.sh on every recovery, and is
+# RESUMABLE: a phase whose output already holds a measured row is
+# skipped, so repeated short health windows each harvest the next
+# phases instead of re-burning the first ones.
+#
+# Every phase uses the abandon protocol (abandon_timeout.sh): a
+# deadline never kills a possibly-compiling child; it leaves the
 # orphan the chip and stops the session (rc=124).
 #
 # New vs chip_session.sh: the mlp_pre point — remat_policy="mlp_pre"
 # saves the pre-gelu tensor and eliminates the wi-matmul recompute
 # (~8% of step FLOPs at the headline shape; estimator says 13.0 GiB,
-# inside the measured-fine batch-48 envelope of 15.74).
+# inside the measured-fine batch-48 envelope of 15.74 GiB).
 set -u
 cd /root/repo
 export PYTHONPATH=/root/repo:/root/.axon_site
 export DTT_BENCH_NO_CLAIM=1
 export JAX_COMPILATION_CACHE_DIR=/root/repo/benchmarks/state/xla_cache
-OUT=${1:?usage: session_continue.sh OUTDIR}
+OUT=${1:-benchmarks/state/session_continue}
 mkdir -p "$OUT"
 echo "session continuation -> $OUT"
 
 analyze_traces() {
   for b in 32 48; do
-    if [ -d "$OUT/trace_b$b" ]; then
+    if [ -d "$OUT/trace_b$b" ] && [ ! -s "$OUT/analyze_trace_b$b.json" ]; then
       JAX_PLATFORMS=cpu timeout 600 python benchmarks/analyze_trace.py \
         "$OUT/trace_b$b" --json >"$OUT/analyze_trace_b$b.json" 2>>"$OUT/session.log"
     fi
@@ -31,8 +36,35 @@ analyze_traces() {
 trap analyze_traces EXIT
 trap 'exit 129' INT TERM
 
-phase_or_stop() {
-  local name=$1 t=$2; shift 2
+# An abandoned orphan from a previous window may still own the chip:
+# running another TPU process would contend on the tunnel, and
+# re-running its phase would truncate the .out file the orphan's
+# stdout still points at. rc=125 tells probe_loop "nothing harvested,
+# keep probing" (only 124 is the abandon-stop signal).
+ORPHAN_PAT='python [^ ]*(tune_headline|bench_1b_single_chip|bench|profile_step)\.py'
+if pgrep -f "$ORPHAN_PAT" >/dev/null 2>&1; then
+  echo "[session] orphan still owns the chip; not starting" | tee -a "$OUT/session.log"
+  exit 125
+fi
+
+# A phase is DONE when its .out carries EVERY point's measured row
+# (mfu / tokens_per_sec) — error rows and partially-harvested
+# multi-point phases don't count, so the missing points retry in the
+# next window.
+phase_done() {  # phase_done NAME EXPECTED_ROWS
+  local n
+  # grep -c prints the 0 itself on no-match; empty only if the file
+  # is missing (never add `|| echo 0` — it would double-print).
+  n=$(grep -c '"mfu"\|tokens_per_sec' "$OUT/$1.out" 2>/dev/null)
+  [ "${n:-0}" -ge "$2" ]
+}
+
+phase_or_stop() {  # phase_or_stop NAME EXPECTED_ROWS TIMEOUT_S CMD...
+  local name=$1 want=$2 t=$3; shift 3
+  if phase_done "$name" "$want"; then
+    echo "[session] phase=$name SKIP (already measured)" | tee -a "$OUT/session.log"
+    return 0
+  fi
   echo "[session] phase=$name start=$(date -u +%H:%M:%S) (abandonable)" | tee -a "$OUT/session.log"
   bash benchmarks/abandon_timeout.sh "$t" "$@" >"$OUT/$name.out" 2>"$OUT/$name.log"
   local rc=$?
@@ -44,23 +76,36 @@ phase_or_stop() {
   return $rc
 }
 
-phase_or_stop mlp_pre 1500 python benchmarks/tune_headline.py --points \
+# Trace phases produce a directory; the .xplane.pb is only written at
+# trace STOP, so a merely-existing dir (crashed/abandoned mid-trace)
+# is NOT complete — gate on the artifact.
+trace_or_stop() {
+  local name=$1 t=$2 dir=$3; shift 3
+  if [ -n "$(find "$dir" -name '*.xplane.pb' -print -quit 2>/dev/null)" ]; then
+    echo "[session] phase=$name SKIP (trace exists)" | tee -a "$OUT/session.log"
+    return 0
+  fi
+  rm -rf "$dir"
+  phase_or_stop "$name" 1 "$t" "$@"
+}
+
+phase_or_stop mlp_pre 1 1500 python benchmarks/tune_headline.py --points \
   '[[32, {"remat_policy": "mlp_pre"}]]'
-phase_or_stop xent_rows 1500 python benchmarks/tune_headline.py --points \
+phase_or_stop xent_rows 2 1500 python benchmarks/tune_headline.py --points \
   '[[32, {"xent_chunk_rows": 512}], [32, {"xent_chunk_rows": 8192}]]'
-phase_or_stop batch48 1800 python benchmarks/tune_headline.py --points '[[48, {}], [40, {}]]'
-phase_or_stop trace48 1200 python benchmarks/profile_step.py --batch 48 \
-  --model-kwargs '{"remat": true, "remat_policy": "mlp"}' \
-  --trace "$OUT/trace_b48"
-phase_or_stop trace32 1200 python benchmarks/profile_step.py --batch 32 \
+phase_or_stop batch48 2 1800 python benchmarks/tune_headline.py --points '[[48, {}], [40, {}]]'
+trace_or_stop trace32 1200 "$OUT/trace_b32" python benchmarks/profile_step.py --batch 32 \
   --model-kwargs '{"remat": true, "remat_policy": "mlp"}' \
   --trace "$OUT/trace_b32"
-phase_or_stop long8k 1800 python benchmarks/tune_headline.py --points \
+trace_or_stop trace48 1200 "$OUT/trace_b48" python benchmarks/profile_step.py --batch 48 \
+  --model-kwargs '{"remat": true, "remat_policy": "mlp"}' \
+  --trace "$OUT/trace_b48"
+phase_or_stop long8k 2 1800 python benchmarks/tune_headline.py --points \
   '[[4, {"seq_len_override": 8192, "max_seq_len": 8192, "attention_window": 1024}], [4, {"seq_len_override": 8192, "max_seq_len": 8192}]]'
-phase_or_stop long16k 1800 python benchmarks/tune_headline.py --points \
+phase_or_stop long16k 1 1800 python benchmarks/tune_headline.py --points \
   '[[2, {"seq_len_override": 16384, "max_seq_len": 16384, "attention_window": 1024}]]'
-phase_or_stop bench1b 2400 python benchmarks/bench_1b_single_chip.py
-phase_or_stop slice7b 1800 python benchmarks/tune_headline.py --points \
+phase_or_stop bench1b 1 2400 python benchmarks/bench_1b_single_chip.py
+phase_or_stop slice7b 1 1800 python benchmarks/tune_headline.py --points \
   '[[1, {"d_model": 4096, "n_layers": 2, "n_heads": 32, "n_kv_heads": 8, "d_ff": 16384, "max_seq_len": 2048, "seq_len_override": 2048, "pos_encoding": "rope", "tie_embeddings": false, "remat": true, "remat_policy": "mlp"}]]'
 
 echo "[session] done $(date -u +%H:%M:%S)" | tee -a "$OUT/session.log"
