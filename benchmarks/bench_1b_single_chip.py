@@ -2,15 +2,19 @@
 """Measured tokens/sec for the BASELINE 1B path on ONE chip.
 
 Runs the FULL transformer_1b (24 layers, d=2048, untied rope — not the
-shrunken test variant) on a single v5e per the plan
-benchmarks/plan_memory.py validates: adafactor (factored second moment
-~2% of params — AdamW's 10.5 GiB of fp32 moments cannot share 16 GiB
-HBM with 5.3 GiB params + 5.3 GiB grads at step peak) and full
-rematerialization. fsdp=1 is expected on one chip; the deliverable is
-the measured config path, not scale.
+shrunken test variant) on a single v5e with adafactor (factored second
+moment ~2% of params — AdamW's 10.5 GiB of fp32 moments cannot share
+16 GiB HBM with 5.3 GiB params + 5.3 GiB grads at step peak). fsdp=1
+is expected on one chip; the deliverable is the measured config path,
+not scale.
 
-Prints one JSON line; an OOM degrades seq_len 1024 → 512 and finally
-swaps adafactor for SGD before giving up (each fallback is recorded).
+Prints one JSON line for the FIRST attempt in the best-first ladder
+that survives: lighter remat policies / larger batch before the
+r4-measured full-remat batch-1 safety net, then seq_len 1024 → 512,
+then adafactor → SGD (each fallback is recorded). All 1024-seq
+programs were compiled device-less by the real TPU compiler first
+(evidence/r5_precompile_20260802.json) — the ladder's OOM risk is
+allocator-level only.
 
     PYTHONPATH=/root/repo:/root/.axon_site python \
         benchmarks/bench_1b_single_chip.py
@@ -28,7 +32,19 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
 
 from bench import _is_oom  # noqa: E402
 
+# Best-first: r4 measured 0.320 MFU with batch 1 + full remat — which
+# re-runs the whole block forward every backward (~+33% step FLOPs).
+# The estimator prices the lighter policies INSIDE 15.75 GiB (params
+# 5.27 + grads 5.27 + adafactor 0.11 fixed): mlp@batch2 = 14.0 GiB,
+# mlp_pre@batch1 = 13.3, mlp@batch1 = 12.5, full@batch1 = 11.4 (the
+# measured r4 config, now the safety net). Each OOM falls through.
 ATTEMPTS = [
+    dict(seq_len=1024, optimizer="adafactor", offload=False,
+         batch=2, remat_policy="mlp"),
+    dict(seq_len=1024, optimizer="adafactor", offload=False,
+         batch=1, remat_policy="mlp_pre"),
+    dict(seq_len=1024, optimizer="adafactor", offload=False,
+         batch=1, remat_policy="mlp"),
     dict(seq_len=1024, optimizer="adafactor", offload=False),
     dict(seq_len=512, optimizer="adafactor", offload=False),
     dict(seq_len=512, optimizer="sgd", offload=False),
@@ -40,11 +56,12 @@ WARMUP = max(1, int(os.environ.get("DTT_1B_WARMUP", "2")))
 def run(seq_len: int, optimizer: str, offload: bool,
         model_name: str = "transformer_1b",
         model_kwargs: dict | None = None,
-        vocab_size: int = 50304) -> dict:
+        vocab_size: int = 50304, batch: int = 1,
+        remat_policy: str = "full") -> dict:
     """``model_name``/``model_kwargs``/``vocab_size`` exist so tests
-    can drive the EXACT measurement path (adafactor + full remat +
-    bf16 + Trainer) at toy scale on CPU; production callers use the
-    defaults."""
+    can drive the EXACT measurement path (adafactor + remat + bf16 +
+    Trainer) at toy scale on CPU; production callers use the
+    ATTEMPTS ladder's values."""
     import jax
 
     from distributed_training_tpu.config import Config
@@ -56,7 +73,7 @@ def run(seq_len: int, optimizer: str, offload: bool,
     from distributed_training_tpu.utils.metrics import peak_flops_per_chip
 
     cfg = Config()
-    cfg.train.batch_size = 1
+    cfg.train.batch_size = batch
     cfg.train.optimizer = optimizer
     cfg.train.learning_rate = 2e-4
     cfg.train.dtype = "bfloat16"
@@ -66,23 +83,27 @@ def run(seq_len: int, optimizer: str, offload: bool,
 
     rt = initialize_runtime(cfg)
     model = build_model(model_name, dtype="bfloat16",
-                        remat=True, remat_policy="full",
+                        remat=True, remat_policy=remat_policy,
                         **(model_kwargs or {}))
     ds = SyntheticLMDataset(size=8, seq_len=seq_len,
                             vocab_size=vocab_size, seed=0)
-    loader = ShardedDataLoader(ds, rt, batch_size=1, shuffle=False)
+    loader = ShardedDataLoader(ds, rt, batch_size=batch, shuffle=False)
     trainer = Trainer(cfg, rt, model, loader)
-    batch = next(iter(loader.epoch(0)))
+    # batch_data, NOT batch: rebinding the int parameter here would
+    # put jax.Arrays into the result dict's "batch" field and crash
+    # json.dumps AFTER a successful chip measurement (caught in
+    # review before it could burn a window).
+    batch_data = next(iter(loader.epoch(0)))
 
     t0 = time.perf_counter()
     for _ in range(WARMUP):
-        metrics = trainer.train_step(batch)
+        metrics = trainer.train_step(batch_data)
     jax.block_until_ready(metrics["loss"])
     compile_s = time.perf_counter() - t0
 
     t0 = time.perf_counter()
     for _ in range(STEPS):
-        metrics = trainer.train_step(batch)
+        metrics = trainer.train_step(batch_data)
     jax.block_until_ready(metrics["loss"])
     dt = time.perf_counter() - t0
 
@@ -96,10 +117,10 @@ def run(seq_len: int, optimizer: str, offload: bool,
         "mfu": round(float(mfu), 4),
         "step_time_ms": round(1000 * dt / STEPS, 1),
         "seq_len": seq_len,
-        "batch": 1,
+        "batch": batch,
         "optimizer": optimizer,
         "offload_opt_state": offload,
-        "remat_policy": "full",
+        "remat_policy": remat_policy,
         "compile_plus_warmup_s": round(compile_s, 1),
         "device_kind": rt.device_kind,
         "loss": round(float(metrics["loss"]), 4),
