@@ -244,6 +244,27 @@ def _fail(stage: str, message: str) -> None:
     sys.exit(1)
 
 
+def _write_postmortem(reason: str) -> str:
+    """Best-effort postmortem bundle (all-thread stacks, per-device
+    memory_stats) under benchmarks/state/postmortem/ — the artifact
+    BENCH_r05's "backend unresponsive" exit was missing. Called from
+    the hang/budget timer threads, so it must never raise and must
+    not initialize a backend (telemetry.watchdog only touches jax if
+    it is already imported)."""
+    try:
+        from distributed_training_tpu.telemetry.watchdog import (
+            write_postmortem)
+        path = write_postmortem(
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "benchmarks", "state", "postmortem"),
+            reason)
+        _phase("postmortem_written", path=path)
+        return path
+    except Exception as e:  # noqa: BLE001 — evidence line must survive
+        _phase("postmortem_failed", error=f"{type(e).__name__}")
+        return ""
+
+
 def probe_backend() -> None:
     """Confirm the accelerator backend answers before committing this
     process to it. PJRT client creation can hang indefinitely when the
@@ -258,6 +279,9 @@ def probe_backend() -> None:
     # the main thread may be blocked in an uninterruptible wait.
     def _budget_fire():
         _phase("probe_budget_expired", budget_s=PROBE_TOTAL_BUDGET_S)
+        _write_postmortem(
+            "probe budget expired: accelerator backend unresponsive "
+            f"for {PROBE_TOTAL_BUDGET_S}s")
         print(json.dumps(_failure_record(
             "probe_backend",
             "accelerator backend unresponsive; total probe budget "
@@ -322,6 +346,10 @@ def _arm_watchdog():
 
     def fire():
         _phase("watchdog_fired", budget_s=RUN_TIMEOUT_S)
+        # The stacks show WHERE the measurement wedged (compile vs.
+        # dispatch vs. a blocked PJRT call) — the attribution every
+        # previous round's timeout message lacked.
+        _write_postmortem(f"bench run exceeded {RUN_TIMEOUT_S}s")
         print(json.dumps(_failure_record(
             "watchdog", f"run exceeded {RUN_TIMEOUT_S}s")), flush=True)
         os._exit(1)
@@ -562,6 +590,23 @@ def main() -> None:
     client is exactly what wedges the axon tunnel for ~40 min
     (measured r3/r4)."""
     child_mode = _child_mode()
+    cancel_pm = None
+    if child_mode:
+        # The abandoned-child protocol means nobody kills this
+        # process — but if it outlives the parent's deadline, a
+        # faulthandler stack dump (no exit, no PJRT disruption) is
+        # scheduled so the orphan's state is on disk when someone
+        # later asks what it was doing.
+        try:
+            from distributed_training_tpu.telemetry.watchdog import (
+                arm_process_watchdog)
+            cancel_pm = arm_process_watchdog(
+                RUN_TIMEOUT_S,
+                os.path.join(CHILD_LOG_DIR, "postmortem"),
+                f"bench child still running at the parent's "
+                f"{RUN_TIMEOUT_S}s deadline (abandoned-child path)")
+        except Exception:  # noqa: BLE001
+            pass
     if not child_mode:
         _claim_chip()
         probe_backend()
@@ -636,6 +681,8 @@ def main() -> None:
                 salvage.cancel()
     final = _result(m)
     record_evidence(final)
+    if cancel_pm is not None:
+        cancel_pm()
     print(json.dumps(final))
 
 

@@ -13,12 +13,22 @@ OUT=$(mktemp /tmp/probe_out.XXXXXX)
 trap 'rm -f "$OUT"' EXIT
 while true; do
   ts=$(date -u +%H:%M:%S)
+  # The probe arms a faulthandler watchdog (telemetry/watchdog.py) at
+  # 130s — inside the interpreter, so the all-thread stack dump lands
+  # in benchmarks/state/postmortem/ BEFORE the outer timeout's
+  # SIGTERM/SIGKILL at 150s. A wedged PJRT init now leaves evidence
+  # of WHERE it blocked, not just a WEDGED status line; a healthy
+  # probe cancels and removes the bundle.
   timeout -k 10 150 env PYTHONPATH=/root/repo:/root/.axon_site python -c "
+from distributed_training_tpu.telemetry.watchdog import arm_process_watchdog
+cancel = arm_process_watchdog(
+    130, '/root/repo/benchmarks/state/postmortem', 'tpu health probe')
 import jax, jax.numpy as jnp
 d = jax.devices()
 x = jnp.ones((512,512), dtype=jnp.bfloat16)
 (x@x).block_until_ready()
 print('OK', d[0].platform)
+cancel()
 " >"$OUT" 2>&1
   rc=$?
   if [ $rc -eq 0 ] && grep -q "OK tpu" "$OUT"; then

@@ -26,6 +26,8 @@ from typing import Any
 
 import orbax.checkpoint as ocp
 
+from distributed_training_tpu import telemetry
+
 logger = logging.getLogger(__name__)
 
 
@@ -46,15 +48,20 @@ class Checkpointer:
 
     def save(self, step: int, state: Any, meta: dict | None = None,
              force: bool = False) -> bool:
-        """Collective sharded save. Call from EVERY process."""
-        saved = self._mgr.save(
-            step,
-            args=ocp.args.Composite(
-                state=ocp.args.StandardSave(state),
-                meta=ocp.args.JsonSave(meta or {}),
-            ),
-            force=force,
-        )
+        """Collective sharded save. Call from EVERY process.
+
+        The ``ckpt_save`` span measures the *blocking* part only —
+        with async checkpointing the drain to storage continues in
+        the background (that tail is what ``ckpt_wait`` captures)."""
+        with telemetry.span("ckpt_save", step=step):
+            saved = self._mgr.save(
+                step,
+                args=ocp.args.Composite(
+                    state=ocp.args.StandardSave(state),
+                    meta=ocp.args.JsonSave(meta or {}),
+                ),
+                force=force,
+            )
         if saved:
             logger.info("checkpoint saved at step %d -> %s", step,
                         self.directory)
@@ -73,13 +80,14 @@ class Checkpointer:
         step = self._mgr.latest_step()
         if step is None:
             return None
-        restored = self._mgr.restore(
-            step,
-            args=ocp.args.Composite(
-                state=ocp.args.StandardRestore(abstract_state),
-                meta=ocp.args.JsonRestore(),
-            ),
-        )
+        with telemetry.span("ckpt_restore", step=step):
+            restored = self._mgr.restore(
+                step,
+                args=ocp.args.Composite(
+                    state=ocp.args.StandardRestore(abstract_state),
+                    meta=ocp.args.JsonRestore(),
+                ),
+            )
         logger.info("restored checkpoint step %d from %s", step,
                     self.directory)
         return restored["state"], dict(restored["meta"] or {})
@@ -88,7 +96,8 @@ class Checkpointer:
 
     def wait(self) -> None:
         """Block until async saves are durable (call before exit)."""
-        self._mgr.wait_until_finished()
+        with telemetry.span("ckpt_wait"):
+            self._mgr.wait_until_finished()
 
     def close(self) -> None:
         self._mgr.close()

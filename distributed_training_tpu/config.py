@@ -67,6 +67,23 @@ class TrainConfig:
     # (loss, samples/sec/chip, mfu, val_loss) as one JSON line. Empty →
     # disabled; the CLI defaults it to <run_dir>/metrics.jsonl.
     metrics_jsonl: str = ""
+    # Structured telemetry stream (spans, goodput windows, hbm samples
+    # — see docs/observability.md). Empty → disabled; the CLI defaults
+    # it to <run_dir>/events.jsonl on the coordinator.
+    events_jsonl: str = ""
+    # Hang watchdog: a step armed longer than this dumps a postmortem
+    # bundle (all-thread stacks, per-device memory_stats, last events)
+    # to <run_dir>/postmortem/. 0 disables. Set it to a generous
+    # multiple of the expected step time — compile is excluded (the
+    # first step arms with a 10x allowance).
+    watchdog_timeout_s: float = 0.0
+    # After the postmortem: hard-exit (rc 42)? Default off — an
+    # attended run may recover; unattended launchers want the abort so
+    # a hung process doesn't hold the accelerator forever.
+    watchdog_abort: bool = False
+    # Steps between hbm telemetry samples (device.memory_stats() into
+    # the event stream). 0 disables.
+    hbm_sample_every: int = 0
     dataset_size: int = 2048
     learning_rate: float = 1e-3
     device: str = "auto"          # "auto" | "tpu" | "cpu"

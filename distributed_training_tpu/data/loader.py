@@ -23,6 +23,7 @@ from typing import Iterator, Mapping
 import jax
 import numpy as np
 
+from distributed_training_tpu import telemetry
 from distributed_training_tpu.data.sampler import DistributedShardSampler
 from distributed_training_tpu.runtime import Runtime
 
@@ -107,7 +108,15 @@ class ShardedDataLoader:
             for step in range(self.steps_per_epoch):
                 sl = slice(step * self.batch_size,
                            (step + 1) * self.batch_size)
-                yield self._assemble(orders[:, sl])
+                # Event-stream-only span (it runs in the prefetch
+                # thread, concurrent with the consumer's step — the
+                # goodput ledger counts only the consumer-side
+                # data_wait). Assemble BEFORE yield so the span
+                # doesn't stay open while the consumer trains.
+                with telemetry.span("data_assemble",
+                                    step_in_epoch=step):
+                    batch = self._assemble(orders[:, sl])
+                yield batch
 
         if self.prefetch_depth > 0:
             yield from _prefetch(produce(), self.prefetch_depth)

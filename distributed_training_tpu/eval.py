@@ -47,6 +47,9 @@ def build_argparser() -> argparse.ArgumentParser:
                    help="platform for scoring (auto|tpu|cpu) — the "
                         "run's trained topology is NOT required; eval "
                         "replicates params over whatever is local")
+    p.add_argument("--events-jsonl", default=None,
+                   help="write telemetry spans/events here (default: "
+                        "off; the summarizer CLI reads the stream)")
     return p
 
 
@@ -60,11 +63,19 @@ def main(argv: list[str] | None = None) -> int:
 
     import numpy as np
 
+    from distributed_training_tpu import telemetry as telemetry_lib
     from distributed_training_tpu.data import (ShardedDataLoader,
                                                build_dataset)
     from distributed_training_tpu.generate import (
         _build_model_from_cfg, _load_run_config, _restore_params)
     from distributed_training_tpu.runtime import initialize_runtime
+
+    if args.events_jsonl:
+        # fresh=False: the natural target is the run's own
+        # events.jsonl — eval must append after a run_start marker,
+        # never truncate the training run's telemetry.
+        telemetry_lib.install(telemetry_lib.Telemetry(
+            events_jsonl=args.events_jsonl, fresh=False))
 
     cfg = _load_run_config(args.run_dir)
     model = _build_model_from_cfg(cfg)
@@ -125,12 +136,13 @@ def main(argv: list[str] | None = None) -> int:
 
     losses = []
     tokens = 0
-    for i, batch in enumerate(loader.epoch(0)):
-        if i >= score_steps:
-            break
-        losses.append(float(score(params, batch)))
-        first = next(iter(batch.values()))
-        tokens += int(np.prod(first.shape))
+    with telemetry_lib.span("eval", run_dir=args.run_dir, step=step):
+        for i, batch in enumerate(loader.epoch(0)):
+            if i >= score_steps:
+                break
+            losses.append(float(score(params, batch)))
+            first = next(iter(batch.values()))
+            tokens += int(np.prod(first.shape))
     if not losses:
         raise ValueError("dataset yielded no batches")
     mean = float(np.mean(losses))
@@ -143,6 +155,7 @@ def main(argv: list[str] | None = None) -> int:
     }
     if padded:
         rec["padded"] = True  # dataset < one global batch; rows repeat
+    telemetry_lib.event("eval_result", **rec)
     print(json.dumps(rec))
     return 0
 

@@ -1,0 +1,36 @@
+"""Telemetry: the observability subsystem.
+
+One instrumentation surface, four consumers:
+
+- ``span()``/``event()`` (events.py) — the structured ``events.jsonl``
+  stream, doubling as XProf trace annotations;
+- ``GoodputLedger`` (goodput.py) — wall-clock decomposed into
+  compile/data_wait/step/checkpoint/eval/idle, goodput% + MFU;
+- ``HangWatchdog`` (watchdog.py) — per-step hang detection with
+  faulthandler/memory-stats/event-tail postmortem bundles;
+- ``HBMSampler`` (hbm.py) — periodic ``device.memory_stats()``
+  samples cross-checked against utils/memory.py estimates.
+
+``python -m distributed_training_tpu.telemetry <run_dir>`` renders it
+all (summarize.py). Event schema and bucket definitions:
+docs/observability.md.
+"""
+
+from distributed_training_tpu.telemetry.events import (  # noqa: F401
+    Telemetry,
+    current,
+    event,
+    install,
+    span,
+    uninstall,
+)
+from distributed_training_tpu.telemetry.goodput import (  # noqa: F401
+    GoodputLedger,
+)
+from distributed_training_tpu.telemetry.hbm import (  # noqa: F401
+    HBMSampler,
+)
+from distributed_training_tpu.telemetry.watchdog import (  # noqa: F401
+    HangWatchdog,
+    write_postmortem,
+)

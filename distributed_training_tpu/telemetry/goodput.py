@@ -1,0 +1,110 @@
+"""Goodput ledger: wall-clock decomposition into named buckets.
+
+Headline throughput alone cannot attribute a 0.32-vs-0.44 MFU
+regression; the decomposition of time into compute vs. everything else
+is what makes a distributed config debuggable (the DDP/FSDP
+characterization stance, arxiv 2505.12832). The ledger accumulates
+host-side seconds into fixed buckets — ``compile``, ``data_wait``,
+``step``, ``checkpoint``, ``eval`` — fed by the telemetry span layer
+(events.py feeds depth-0 spans only); anything untracked is ``idle``,
+derived as wall minus the tracked sum, so the report always sums to
+wall-clock exactly.
+
+Interpretation under async dispatch: ``step`` is host time spent in
+(or blocked on) the dispatch path. Once the device queue backs up,
+dispatch blocks on device availability, so over any window longer
+than a few steps ``step`` tracks device busy time; ``goodput`` =
+step / wall is the fraction of wall-clock the accelerator spent on
+training steps.
+"""
+
+from __future__ import annotations
+
+import time
+
+# Report bucket order (idle appended by report()).
+BUCKETS = ("compile", "data_wait", "step", "checkpoint", "eval")
+
+# span name -> bucket. Spans not named here (e.g. the loader's
+# data_assemble, which runs concurrently in the prefetch thread and
+# would double-count) appear in the event stream only.
+SPAN_BUCKET = {
+    "compile": "compile",
+    "data_wait": "data_wait",
+    "step": "step",
+    "ckpt_save": "checkpoint",
+    "ckpt_restore": "checkpoint",
+    "ckpt_wait": "checkpoint",
+    "eval": "eval",
+}
+
+
+class GoodputLedger:
+    """Accumulates bucket seconds + step counts; reports goodput/MFU.
+
+    ``flops_per_step`` (model FLOPs per optimizer step, all chips) and
+    ``peak_flops`` (per chip) turn the window arithmetic into MFU —
+    the same accounting as utils/metrics.py but measured against
+    *wall* clock, so (goodput x step-window MFU) decomposes a headline
+    MFU shortfall into "device was idle" vs "device was slow".
+    """
+
+    def __init__(self, flops_per_step: float = 0.0,
+                 num_devices: int = 1, peak_flops: float = 0.0):
+        self.flops_per_step = flops_per_step
+        self.num_devices = max(1, num_devices)
+        self.peak_flops = peak_flops
+        self.reset()
+
+    def reset(self) -> None:
+        self._t0 = time.perf_counter()
+        self._buckets = dict.fromkeys(BUCKETS, 0.0)
+        self._steps = 0
+        self._w_t0 = self._t0
+        self._w_buckets = dict.fromkeys(BUCKETS, 0.0)
+        self._w_steps = 0
+
+    def add(self, span_name: str, dur_s: float, steps: int = 0) -> None:
+        bucket = SPAN_BUCKET.get(span_name)
+        if bucket is None:
+            return
+        self._buckets[bucket] += dur_s
+        self._w_buckets[bucket] += dur_s
+        if bucket == "step":  # compile steps don't count toward MFU
+            self._steps += steps
+            self._w_steps += steps
+
+    def _report(self, t0: float, buckets: dict, steps: int) -> dict:
+        wall = max(time.perf_counter() - t0, 1e-9)
+        tracked = sum(buckets.values())
+        rep = {k: round(v, 4) for k, v in buckets.items()}
+        rep["idle"] = round(max(wall - tracked, 0.0), 4)
+        out = {
+            "wall_s": round(wall, 4),
+            "buckets": rep,
+            "steps": steps,
+            "goodput": round(buckets["step"] / wall, 4),
+        }
+        if self.flops_per_step and self.peak_flops:
+            out["mfu_wall"] = round(
+                steps * self.flops_per_step
+                / (wall * self.num_devices * self.peak_flops), 4)
+            step_s = buckets["step"]
+            if step_s > 0:
+                out["mfu_step"] = round(
+                    steps * self.flops_per_step
+                    / (step_s * self.num_devices * self.peak_flops), 4)
+        return out
+
+    def window_report(self) -> dict:
+        """Report since the last window_report (or reset), then start a
+        new window — the per-``log_every`` trajectory record."""
+        rep = self._report(self._w_t0, self._w_buckets, self._w_steps)
+        self._w_t0 = time.perf_counter()
+        self._w_buckets = dict.fromkeys(BUCKETS, 0.0)
+        self._w_steps = 0
+        return rep
+
+    def report(self) -> dict:
+        """Cumulative report since reset (the run-level summary)."""
+        return self._report(self._t0, self._buckets, self._steps)

@@ -1,0 +1,61 @@
+"""HBM telemetry: periodic ``device.memory_stats()`` samples.
+
+utils/memory.py predicts the footprint before a run; this records what
+the allocator actually did during one, into the same event stream the
+goodput ledger and watchdog share — so an OOM (or a near-miss that
+degrades scheduling, the measured batch-48 regression in
+docs/performance.md) is attributable from the run's own artifacts. The
+optional ``estimate_bytes`` (e.g. utils/memory.py's exact
+params+grads+opt-state accounting) rides along on every sample as the
+cross-check: a large, growing gap between estimate and ``bytes_in_use``
+means activations/fragmentation, not state.
+
+CPU backends report no allocator stats (``memory_stats()`` is None);
+samples then carry ``"stats": null`` so a run's stream is
+schema-stable across platforms.
+"""
+
+from __future__ import annotations
+
+# memory_stats keys worth streaming (full dicts carry ~20 noisy
+# counters; these are the ones a postmortem actually reads).
+_KEYS = ("bytes_in_use", "peak_bytes_in_use", "largest_alloc_size",
+         "bytes_limit", "num_allocs")
+
+
+class HBMSampler:
+    """Emit an ``hbm`` event every ``every`` steps (0 disables)."""
+
+    def __init__(self, telemetry, every: int = 0,
+                 estimate_bytes: int = 0, devices=None):
+        self.telemetry = telemetry
+        self.every = every
+        self.estimate_bytes = int(estimate_bytes)
+        self._devices = devices
+
+    def _device_list(self):
+        if self._devices is None:
+            import jax
+            self._devices = list(jax.local_devices())
+        return self._devices
+
+    def maybe_sample(self, step: int) -> None:
+        if self.every > 0 and step % self.every == 0:
+            self.sample(step)
+
+    def sample(self, step: int) -> None:
+        devices = []
+        for i, d in enumerate(self._device_list()):
+            try:
+                raw = d.memory_stats()
+            except Exception as e:  # noqa: BLE001 — telemetry must not kill the step loop
+                devices.append({"id": i,
+                                "error": f"{type(e).__name__}: {e}"})
+                continue
+            stats = ({k: int(raw[k]) for k in _KEYS if k in raw}
+                     if raw else None)
+            devices.append({"id": i, "stats": stats})
+        rec = {"step": step, "devices": devices}
+        if self.estimate_bytes:
+            rec["estimate_bytes"] = self.estimate_bytes
+        self.telemetry.event("hbm", **rec)

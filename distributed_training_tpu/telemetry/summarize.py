@@ -1,0 +1,252 @@
+"""Run summarizer: render a run_dir's jsonl streams as one report.
+
+    python -m distributed_training_tpu.telemetry <run_dir> [--json]
+
+Reads ``metrics.jsonl`` (loss/throughput/MFU trajectory, written by
+utils/metrics.py) and ``events.jsonl`` (spans, goodput windows, hbm
+samples, watchdog firings — written by this package) and prints the
+answers a post-run triage actually asks: did the loss move, where did
+the wall-clock go, how close to the HBM ceiling did it run, and did
+anything hang. Works on partial streams (a crashed run's artifacts are
+exactly when this gets used), and lists any ``postmortem/`` bundles it
+finds.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from distributed_training_tpu.telemetry.goodput import BUCKETS
+
+
+def load_jsonl(path: str) -> list[dict]:
+    """Tolerant jsonl reader: skips torn/corrupt lines (a crashed
+    writer's last line is often half-flushed)."""
+    rows: list[dict] = []
+    if not os.path.exists(path):
+        return rows
+    with open(path, errors="replace") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(rec, dict):
+                rows.append(rec)
+    return rows
+
+
+def _loss_stats(rows: list[dict]) -> dict | None:
+    pts = [(r["step"], r["loss"]) for r in rows
+           if isinstance(r.get("loss"), (int, float))
+           and isinstance(r.get("step"), int)]
+    if not pts:
+        return None
+    losses = [v for _, v in pts]
+    return {"first": losses[0], "last": losses[-1],
+            "min": min(losses), "points": len(pts),
+            "first_step": pts[0][0], "last_step": pts[-1][0]}
+
+
+def _trajectory(rows: list[dict], key: str) -> dict | None:
+    vals = [r[key] for r in rows
+            if isinstance(r.get(key), (int, float))
+            and not r.get("warmup")]
+    if not vals:
+        return None
+    return {"first": vals[0], "last": vals[-1], "max": max(vals)}
+
+
+def _goodput(events: list[dict]) -> dict | None:
+    """Prefer the trainer's run-scope ledger report; fall back to
+    re-aggregating depth-0 spans (a killed run emits no final
+    report, but its spans are all on disk)."""
+    runs = [e for e in events
+            if e.get("kind") == "goodput" and e.get("scope") == "run"]
+    if runs:
+        return {k: runs[-1][k] for k in
+                ("wall_s", "buckets", "steps", "goodput", "mfu_wall",
+                 "mfu_step") if k in runs[-1]}
+    from distributed_training_tpu.telemetry.goodput import SPAN_BUCKET
+    buckets = dict.fromkeys(BUCKETS, 0.0)
+    steps = 0
+    # Wall-clock is summed PER run_start segment: the stream may hold
+    # several sessions (a resume, or an eval appended hours after a
+    # crash — eval.py's fresh=False path), and spanning first-to-last
+    # timestamp across sessions would book the dead time between them
+    # as idle.
+    wall = 0.0
+    t_first = t_last = None
+    for e in events:
+        t = e.get("t")
+        if isinstance(t, (int, float)):
+            if e.get("kind") == "run_start" and t_first is not None:
+                wall += max(t_last - t_first, 0.0)
+                t_first = None
+            t_first = t if t_first is None else t_first
+            t_last = t
+        if e.get("kind") != "span" or e.get("depth", 0) != 0:
+            continue
+        bucket = SPAN_BUCKET.get(e.get("name"))
+        if bucket is None or not isinstance(e.get("dur_s"),
+                                            (int, float)):
+            continue
+        buckets[bucket] += e["dur_s"]
+        steps += 1 if e.get("name") == "step" else 0
+    if t_first is not None:
+        wall += max(t_last - t_first, 0.0)
+    if wall <= 0:
+        return None
+    buckets = {k: round(v, 4) for k, v in buckets.items()}
+    buckets["idle"] = round(max(wall - sum(buckets.values()), 0.0), 4)
+    return {"wall_s": round(wall, 4), "buckets": buckets,
+            "steps": steps,
+            "goodput": round(buckets["step"] / wall, 4),
+            "reconstructed": True}
+
+
+def _hbm(events: list[dict]) -> dict | None:
+    """Per-device high-water marks over all hbm samples."""
+    peak: dict[int, int] = {}
+    estimate = None
+    samples = 0
+    for e in events:
+        if e.get("kind") != "hbm":
+            continue
+        samples += 1
+        estimate = e.get("estimate_bytes", estimate)
+        for d in e.get("devices", []):
+            stats = d.get("stats") or {}
+            v = stats.get("peak_bytes_in_use",
+                          stats.get("bytes_in_use"))
+            if isinstance(v, int):
+                peak[d.get("id", -1)] = max(
+                    peak.get(d.get("id", -1), 0), v)
+    if not samples:
+        return None
+    out: dict = {"samples": samples}
+    if peak:
+        out["peak_bytes_by_device"] = peak
+        out["peak_gib"] = round(max(peak.values()) / 1024 ** 3, 3)
+    if estimate:
+        out["estimate_bytes"] = estimate
+    return out
+
+
+def _spans(events: list[dict]) -> dict:
+    agg: dict[str, dict] = {}
+    for e in events:
+        if e.get("kind") != "span":
+            continue
+        a = agg.setdefault(e.get("name", "?"),
+                           {"count": 0, "total_s": 0.0, "max_s": 0.0})
+        dur = e.get("dur_s") or 0.0
+        a["count"] += 1
+        a["total_s"] = round(a["total_s"] + dur, 4)
+        a["max_s"] = round(max(a["max_s"], dur), 4)
+    return agg
+
+
+def summarize_run(run_dir: str) -> dict:
+    metrics = load_jsonl(os.path.join(run_dir, "metrics.jsonl"))
+    events = load_jsonl(os.path.join(run_dir, "events.jsonl"))
+    pm_dir = os.path.join(run_dir, "postmortem")
+    postmortems = (sorted(os.listdir(pm_dir))
+                   if os.path.isdir(pm_dir) else [])
+    summary: dict = {
+        "run_dir": run_dir,
+        "metrics_rows": len(metrics),
+        "event_rows": len(events),
+        "loss": _loss_stats(metrics),
+        "samples_per_sec_per_chip": _trajectory(
+            metrics, "samples_per_sec_per_chip"),
+        "mfu": _trajectory(metrics, "mfu"),
+        "goodput": _goodput(events),
+        "hbm": _hbm(events),
+        "spans": _spans(events),
+        "watchdog_firings": [e for e in events
+                             if e.get("kind") == "watchdog_fired"],
+        "postmortems": postmortems,
+    }
+    return summary
+
+
+def render(summary: dict) -> str:
+    """Human-readable report (the --json flag skips this)."""
+    lines = [f"run: {summary['run_dir']}",
+             f"  metrics rows: {summary['metrics_rows']}   "
+             f"event rows: {summary['event_rows']}"]
+    loss = summary.get("loss")
+    if loss:
+        lines.append(
+            f"loss: {loss['first']:.6g} -> {loss['last']:.6g} "
+            f"(min {loss['min']:.6g}) over steps "
+            f"{loss['first_step']}..{loss['last_step']}")
+    for key, label in (("samples_per_sec_per_chip",
+                        "samples/s/chip"), ("mfu", "mfu")):
+        t = summary.get(key)
+        if t:
+            lines.append(f"{label}: first {t['first']:.4g}  "
+                         f"last {t['last']:.4g}  max {t['max']:.4g}")
+    gp = summary.get("goodput")
+    if gp:
+        tag = " (reconstructed from spans)" if gp.get(
+            "reconstructed") else ""
+        lines.append(f"goodput: {gp['goodput']:.1%} of "
+                     f"{gp['wall_s']:.1f}s wall, {gp['steps']} "
+                     f"steps{tag}")
+        width = max(len(k) for k in gp["buckets"])
+        for k, v in gp["buckets"].items():
+            pct = v / gp["wall_s"] if gp["wall_s"] else 0.0
+            lines.append(f"  {k.ljust(width)}  {v:9.3f}s  {pct:6.1%}")
+        for k in ("mfu_wall", "mfu_step"):
+            if k in gp:
+                lines.append(f"  {k}: {gp[k]:.4f}")
+    hbm = summary.get("hbm")
+    if hbm:
+        line = f"hbm: {hbm['samples']} samples"
+        if "peak_gib" in hbm:
+            line += f", peak {hbm['peak_gib']} GiB"
+        if "estimate_bytes" in hbm:
+            line += (f" (state estimate "
+                     f"{hbm['estimate_bytes'] / 1024 ** 3:.3f} GiB)")
+        lines.append(line)
+    spans = summary.get("spans") or {}
+    if spans:
+        lines.append("spans (count / total / max):")
+        for name in sorted(spans, key=lambda n: -spans[n]["total_s"]):
+            a = spans[name]
+            lines.append(f"  {name:14s} {a['count']:5d}  "
+                         f"{a['total_s']:9.3f}s  {a['max_s']:8.3f}s")
+    for w in summary.get("watchdog_firings", []):
+        lines.append(f"WATCHDOG FIRED: {w.get('postmortem')}")
+    for p in summary.get("postmortems", []):
+        lines.append(f"postmortem bundle: postmortem/{p}")
+    if not summary["metrics_rows"] and not summary["event_rows"]:
+        lines.append("no metrics.jsonl / events.jsonl rows found")
+    return "\n".join(lines)
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m distributed_training_tpu.telemetry",
+        description="Summarize a run_dir's metrics/events streams")
+    p.add_argument("run_dir")
+    p.add_argument("--json", action="store_true",
+                   help="emit the summary as one JSON object")
+    args = p.parse_args(argv)
+    if not os.path.isdir(args.run_dir):
+        print(f"not a directory: {args.run_dir}", file=sys.stderr)
+        return 2
+    summary = summarize_run(args.run_dir)
+    if args.json:
+        print(json.dumps(summary))
+    else:
+        print(render(summary))
+    return 0

@@ -1,0 +1,247 @@
+"""Hang watchdog + postmortem bundles.
+
+BENCH_r05 ended as "accelerator backend unresponsive after 3 probes"
+with zero artifacts explaining where the hang was. This module makes a
+hang produce evidence: a daemon thread is armed before each step and
+disarmed after; if a step exceeds the timeout it writes a postmortem
+directory — faulthandler stacks of ALL threads (works even when the
+main thread is blocked inside an uninterruptible C call, e.g. a wedged
+PJRT collective), per-device ``memory_stats()``, and the tail of the
+telemetry event stream — before optionally aborting the process.
+
+``write_postmortem`` is also callable directly (bench.py's run
+watchdog, probe budget expiry), and ``arm_process_watchdog`` arms a
+pure-faulthandler fallback for subprocesses that may be SIGKILLed from
+outside (benchmarks/probe_loop.sh): the stack dump is scheduled inside
+the interpreter, so it lands on disk before the external ``timeout -k``
+fires.
+
+Dump ordering is deliberate: meta + stacks first (pure host-side,
+cannot hang), device memory stats last (touches the backend, which is
+exactly what may be wedged) — a hang mid-dump still leaves the stacks.
+"""
+
+from __future__ import annotations
+
+import atexit
+import faulthandler
+import itertools
+import json
+import os
+import sys
+import threading
+import time
+
+# Monotonic per-process suffix: two postmortems in the same second
+# (e.g. a watchdog firing while a budget timer also fires) must land
+# in distinct bundles, not overwrite each other.
+_SEQ = itertools.count()
+
+
+def _device_memory_stats() -> list[dict]:
+    """Best-effort per-device ``memory_stats()``. Queries jax only if a
+    backend is ALREADY initialized — merely-imported is not enough (this
+    package's own __init__ imports jax), and ``jax.devices()`` in a
+    jax-idle process would initialize (and claim) a backend from inside
+    a postmortem, which is how a dump turns into a second hang."""
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return []
+    xb = sys.modules.get("jax._src.xla_bridge")
+    if xb is None or not getattr(xb, "_backends", None):
+        return []
+    out = []
+    for i, d in enumerate(jax.devices()):
+        try:
+            stats = d.memory_stats()
+        except Exception as e:  # noqa: BLE001 — postmortem best-effort
+            out.append({"id": i, "error": f"{type(e).__name__}: {e}"})
+            continue
+        out.append({"id": i, "kind": d.device_kind,
+                    "stats": dict(stats) if stats else None})
+    return out
+
+
+def write_postmortem(base_dir: str, reason: str,
+                     events_tail: list | None = None,
+                     extra: dict | None = None) -> str:
+    """Write one timestamped postmortem bundle; returns its path.
+
+    Contents: ``meta.json`` (reason, pid, time, extra), ``stacks.txt``
+    (all-thread tracebacks), ``memory_stats.json`` (per-device), and
+    ``events_tail.jsonl`` (the last N telemetry events, when given).
+    Never raises — a postmortem writer that can crash its host process
+    is worse than no postmortem."""
+    stamp = time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
+    path = os.path.join(
+        base_dir, f"{stamp}_pid{os.getpid()}_{next(_SEQ)}")
+    try:
+        os.makedirs(path, exist_ok=True)
+        with open(os.path.join(path, "meta.json"), "w") as f:
+            json.dump({"reason": reason, "time_unix": time.time(),
+                       "pid": os.getpid(), **(extra or {})}, f,
+                      indent=1)
+        with open(os.path.join(path, "stacks.txt"), "w") as f:
+            faulthandler.dump_traceback(file=f, all_threads=True)
+        with open(os.path.join(path, "events_tail.jsonl"), "w") as f:
+            for rec in events_tail or []:
+                f.write(json.dumps(rec) + "\n")
+        # memory_stats queries the backend — the component that may be
+        # wedged. Collect it in a bounded daemon thread so a hung query
+        # can never block the caller (bench's budget timers os._exit
+        # right after this; a postmortem that hangs its own escape
+        # hatch is worse than a missing memory_stats.json — and an
+        # absent/empty file is itself a finding: the backend didn't
+        # answer).
+        def _dump_memory():
+            stats = _device_memory_stats()
+            with open(os.path.join(path, "memory_stats.json"),
+                      "w") as f:
+                json.dump(stats, f, indent=1)
+        t = threading.Thread(target=_dump_memory, daemon=True,
+                             name="postmortem-memory-stats")
+        t.start()
+        t.join(timeout=10)
+    except Exception:  # noqa: BLE001
+        pass
+    return path
+
+
+class HangWatchdog:
+    """Per-step hang detector: ``arm()`` before dispatch, ``disarm()``
+    after the step's host work completes. A step that stays armed past
+    ``timeout_s`` gets a postmortem bundle under ``postmortem_dir``;
+    ``abort=True`` then hard-exits (rc 42) — the mode for unattended
+    runs where a hung process holding the accelerator is worse than a
+    dead one. Re-arming after a firing resets the trigger, so a run
+    that recovers can still document a later hang.
+    """
+
+    EXIT_CODE = 42
+
+    def __init__(self, timeout_s: float, postmortem_dir: str,
+                 telemetry=None, abort: bool = False,
+                 poll_s: float | None = None):
+        self.timeout_s = timeout_s
+        self.postmortem_dir = postmortem_dir
+        self.telemetry = telemetry
+        self.abort = abort
+        self.fired_path: str | None = None
+        self._cond = threading.Condition()
+        self._armed_at: float | None = None
+        self._timeout_cur = timeout_s
+        self._info: dict = {}
+        self._fired = False
+        self._stopped = False
+        self._poll = poll_s if poll_s is not None else max(
+            0.05, min(1.0, timeout_s / 4))
+        self._thread = threading.Thread(
+            target=self._loop, name="hang-watchdog", daemon=True)
+        self._thread.start()
+
+    def arm(self, timeout_s: float | None = None, **info) -> None:
+        """Start the countdown for one step. ``timeout_s`` overrides
+        the default for this arm only (the trainer gives the first,
+        compile-dominated step a larger allowance)."""
+        with self._cond:
+            self._armed_at = time.monotonic()
+            self._timeout_cur = (timeout_s if timeout_s is not None
+                                 else self.timeout_s)
+            self._info = info
+            self._fired = False
+            self._cond.notify()
+
+    def disarm(self) -> None:
+        with self._cond:
+            self._armed_at = None
+            self._cond.notify()
+
+    def stop(self) -> None:
+        with self._cond:
+            self._stopped = True
+            self._cond.notify()
+        self._thread.join(timeout=5)
+
+    def _loop(self) -> None:
+        while True:
+            with self._cond:
+                if self._stopped:
+                    return
+                armed_at, fired = self._armed_at, self._fired
+                timeout, info = self._timeout_cur, dict(self._info)
+                self._cond.wait(self._poll)
+            if (armed_at is None or fired
+                    or time.monotonic() - armed_at < timeout):
+                continue
+            with self._cond:
+                # Re-check under the lock: the step may have disarmed
+                # (or re-armed a NEWER step) while we were deciding.
+                if self._armed_at != armed_at or self._fired:
+                    continue
+                self._fired = True
+            self._fire(info, timeout)
+
+    def _fire(self, info: dict, timeout_s: float) -> None:
+        tail = self.telemetry.tail() if self.telemetry else None
+        self.fired_path = write_postmortem(
+            self.postmortem_dir,
+            f"step exceeded watchdog timeout {timeout_s}s",
+            events_tail=tail,
+            extra={"watchdog_timeout_s": timeout_s, **info})
+        if self.telemetry is not None:
+            self.telemetry.event("watchdog_fired",
+                                 postmortem=self.fired_path,
+                                 timeout_s=timeout_s, **info)
+        if self.abort:
+            # The stacks are on disk; a process wedged in a C call
+            # cannot run atexit handlers anyway.
+            os._exit(self.EXIT_CODE)
+
+
+def arm_process_watchdog(timeout_s: float, postmortem_dir: str,
+                         reason: str):
+    """Faulthandler-only process watchdog for externally-killed
+    subprocesses (the probe loop's ``timeout -k`` children): schedules
+    an all-thread stack dump into a postmortem bundle at ``timeout_s``.
+    Returns ``cancel()`` — call it on success to cancel the dump and
+    remove the (then-empty) bundle. ``cancel`` is idempotent and also
+    registered atexit, so an error exit that never reaches the success
+    path doesn't litter the postmortem dir with empty decoy bundles; a
+    bundle whose dump actually FIRED (non-empty stacks) is always
+    kept."""
+    stamp = time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
+    path = os.path.join(
+        postmortem_dir, f"{stamp}_pid{os.getpid()}_{next(_SEQ)}")
+    os.makedirs(path, exist_ok=True)
+    with open(os.path.join(path, "meta.json"), "w") as f:
+        json.dump({"reason": reason, "armed_at_unix": time.time(),
+                   "timeout_s": timeout_s, "pid": os.getpid()}, f,
+                  indent=1)
+    stacks_path = os.path.join(path, "stacks.txt")
+    stacks = open(stacks_path, "w")
+    faulthandler.dump_traceback_later(timeout_s, file=stacks)
+    done = []
+
+    def cancel() -> None:
+        if done:
+            return
+        done.append(True)
+        faulthandler.cancel_dump_traceback_later()
+        stacks.close()
+        try:
+            if os.path.getsize(stacks_path) > 0:
+                return  # the dump fired: the bundle is evidence
+        except OSError:
+            pass
+        for name in ("stacks.txt", "meta.json"):
+            try:
+                os.remove(os.path.join(path, name))
+            except OSError:
+                pass
+        try:
+            os.rmdir(path)
+        except OSError:
+            pass
+
+    atexit.register(cancel)
+    return cancel

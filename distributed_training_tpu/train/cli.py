@@ -53,10 +53,13 @@ def main(argv: list[str] | None = None) -> int:
                   rt.process_index)
     if not cfg.train.metrics_jsonl:
         cfg.train.metrics_jsonl = os.path.join(run_dir, "metrics.jsonl")
+    if not cfg.train.events_jsonl:
+        cfg.train.events_jsonl = os.path.join(run_dir, "events.jsonl")
     logger.info("config loaded; %s", rt.describe())
     if rt.is_coordinator:
         save_resolved(cfg, os.path.join(run_dir, "resolved_config.yaml"))
 
+    from distributed_training_tpu import telemetry as telemetry_lib
     from distributed_training_tpu.checkpoint import Checkpointer
     from distributed_training_tpu.data import (ShardedDataLoader,
                                                build_dataset)
@@ -98,16 +101,38 @@ def main(argv: list[str] | None = None) -> int:
     from distributed_training_tpu.utils.preemption import PreemptionGuard
     guard = PreemptionGuard.install()
 
+    # Telemetry: event stream on the coordinator (spans/goodput/hbm —
+    # docs/observability.md), hang watchdog on EVERY process (hangs
+    # are host-specific; each host writes its own postmortem bundle).
+    resumed = checkpointer.latest_step() is not None
+    tel = telemetry_lib.install(telemetry_lib.Telemetry(
+        events_jsonl=cfg.train.events_jsonl,
+        enabled=rt.is_coordinator,
+        fresh=not resumed,
+        start_step=checkpointer.latest_step() or 0))
+    watchdog = None
+    if cfg.train.watchdog_timeout_s > 0:
+        watchdog = telemetry_lib.HangWatchdog(
+            cfg.train.watchdog_timeout_s,
+            os.path.join(run_dir, "postmortem"),
+            telemetry=tel, abort=cfg.train.watchdog_abort)
+
     trainer = Trainer(cfg, rt, model, loader, checkpointer,
-                      preemption_guard=guard, eval_loader=eval_loader)
-    if cfg.train.profile_dir:
-        from distributed_training_tpu.utils import profiler
-        with profiler.trace(cfg.train.profile_dir,
-                            host_only_on_coordinator=True,
-                            process_index=rt.process_index):
+                      preemption_guard=guard, eval_loader=eval_loader,
+                      watchdog=watchdog)
+    try:
+        if cfg.train.profile_dir:
+            from distributed_training_tpu.utils import profiler
+            with profiler.trace(cfg.train.profile_dir,
+                                host_only_on_coordinator=True,
+                                process_index=rt.process_index):
+                summary = trainer.train()
+        else:
             summary = trainer.train()
-    else:
-        summary = trainer.train()
+    finally:
+        if watchdog is not None:
+            watchdog.stop()
+        tel.close()
     if rt.is_coordinator:
         logger.info("training done: %s", summary)
     checkpointer.close()

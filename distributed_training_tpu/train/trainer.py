@@ -21,13 +21,15 @@ import numpy as np
 import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from distributed_training_tpu import telemetry as telemetry_lib
 from distributed_training_tpu.config import Config
 from distributed_training_tpu.models.base import count_params
 from distributed_training_tpu.parallel.strategy import ShardingStrategy
 from distributed_training_tpu.runtime import Runtime
 from distributed_training_tpu.train import state as state_lib
 from distributed_training_tpu.train.optimizer import build_optimizer
-from distributed_training_tpu.utils.metrics import MetricsLogger
+from distributed_training_tpu.utils.metrics import (MetricsLogger,
+                                                    peak_flops_per_chip)
 
 logger = logging.getLogger(__name__)
 
@@ -155,7 +157,8 @@ class Trainer:
 
     def __init__(self, cfg: Config, runtime: Runtime, model,
                  loader, checkpointer=None, preemption_guard=None,
-                 eval_loader=None, abstract: bool = False):
+                 eval_loader=None, abstract: bool = False,
+                 watchdog=None):
         self.cfg = cfg
         self.rt = runtime
         self.model = model
@@ -166,6 +169,19 @@ class Trainer:
         # Cooperative stop flag (SIGTERM → save + clean exit); see
         # utils/preemption.py. None → never stops early.
         self.preemption_guard = preemption_guard
+        # Observability: the ambient Telemetry (entrypoints install it
+        # — telemetry.install(...); the default is a no-op sink whose
+        # spans still mark XProf trace regions). Bound BEFORE the
+        # checkpoint restore below so ckpt_restore spans are captured.
+        self.telemetry = telemetry_lib.current()
+        # Hang watchdog (telemetry/watchdog.py), armed around every
+        # step in _run_epoch; owned by the caller (cli builds it from
+        # train.watchdog_timeout_s and stops it after train()).
+        self.watchdog = watchdog
+        self.ledger = None
+        self.hbm = None
+        self._steps_dispatched = 0
+        self._div_check_compiled = False
         # Model/dataset contract check BEFORE any tracing: a mismatch
         # (e.g. model=byte_lm with the default regression dataset)
         # otherwise dies as a bare KeyError inside the jitted step.
@@ -327,11 +343,12 @@ class Trainer:
         # async dispatch + prefetch.
         self.global_step = int(self.state["step"])
 
+        flops_per_sample = (model.flops_per_sample()
+                            if hasattr(model, "flops_per_sample") else 0)
         self.metrics = MetricsLogger(
             log_every=tcfg.log_every,
             samples_per_step=loader.global_batch,
-            flops_per_sample=(model.flops_per_sample()
-                              if hasattr(model, "flops_per_sample") else 0),
+            flops_per_sample=flops_per_sample,
             num_devices=runtime.num_devices,
             enabled=runtime.is_coordinator,
             device_kind=runtime.device_kind,
@@ -339,6 +356,47 @@ class Trainer:
             jsonl_fresh=(restored is None),
             start_step=self.global_step,
         )
+
+        # HBM cross-check input: the exact per-device state residency
+        # (utils/memory.py), computed from shape trees (cheap) so a
+        # telemetry sink installed after construction can still get it.
+        from distributed_training_tpu.utils.memory import (
+            state_bytes_per_device)
+        self._state_bytes_est = (
+            state_bytes_per_device(
+                param_shapes, self.state_shardings["params"])
+            + state_bytes_per_device(
+                opt_shapes, self.state_shardings["opt_state"]))
+        self._flops_per_step = flops_per_sample * loader.global_batch
+        self._bind_telemetry()
+
+    def _bind_telemetry(self) -> None:
+        """(Re)resolve the ambient Telemetry and build the goodput
+        ledger + HBM sampler against it. Called at construction AND at
+        the top of train(): an embedder that install()s after building
+        the Trainer must not silently get a null-sink run where the
+        checkpoint manager's module-level spans record but the
+        trainer's (and the ledger's buckets) don't."""
+        tel = telemetry_lib.current()
+        if tel is self.telemetry and (self.ledger is not None
+                                      or not tel.enabled):
+            return
+        self.telemetry = tel
+        if not tel.enabled:
+            self.ledger = self.hbm = None
+            return
+        # Goodput ledger: depth-0 telemetry spans land in its buckets
+        # (events.py), so wall-clock decomposes into compile/data_wait/
+        # step/checkpoint/eval/idle with MFU computed from the same
+        # FLOPs accounting as the metrics stream.
+        self.ledger = telemetry_lib.GoodputLedger(
+            flops_per_step=self._flops_per_step,
+            num_devices=self.rt.num_devices,
+            peak_flops=peak_flops_per_chip(self.rt.device_kind))
+        tel.attach_ledger(self.ledger)
+        self.hbm = telemetry_lib.HBMSampler(
+            tel, every=self.cfg.train.hbm_sample_every,
+            estimate_bytes=self._state_bytes_est)
 
     # -- cooperative stop / health ----------------------------------------
 
@@ -431,20 +489,28 @@ class Trainer:
     # -- loops -------------------------------------------------------------
 
     def train_step(self, batch) -> Mapping[str, jax.Array]:
-        if self._offload:
-            # Stream the moments host->device for the compiled step and
-            # back to their pinned-host residency after — the torch-
-            # FSDP-offload semantic (state lives on host, visits the
-            # accelerator per step). Transfers are async dispatches.
-            self.state["opt_state"] = jax.device_put(
-                self.state["opt_state"],
-                self._device_state_shardings["opt_state"])
-        self.state, metrics = self._step_fn(self.state, batch,
-                                            self.step_rng)
-        if self._offload:
-            self.state["opt_state"] = jax.device_put(
-                self.state["opt_state"],
-                self.state_shardings["opt_state"])
+        # The first dispatch traces + compiles (blocking), so it is a
+        # "compile" span/bucket; steady-state dispatches are "step".
+        # Under async dispatch a "step" span is host time in (or
+        # blocked on) the dispatch path — see telemetry/goodput.py.
+        name = "compile" if self._steps_dispatched == 0 else "step"
+        with self.telemetry.span(name, step=self.global_step + 1):
+            if self._offload:
+                # Stream the moments host->device for the compiled
+                # step and back to their pinned-host residency after —
+                # the torch-FSDP-offload semantic (state lives on
+                # host, visits the accelerator per step). Transfers
+                # are async dispatches.
+                self.state["opt_state"] = jax.device_put(
+                    self.state["opt_state"],
+                    self._device_state_shardings["opt_state"])
+            self.state, metrics = self._step_fn(self.state, batch,
+                                                self.step_rng)
+            if self._offload:
+                self.state["opt_state"] = jax.device_put(
+                    self.state["opt_state"],
+                    self.state_shardings["opt_state"])
+        self._steps_dispatched += 1
         self.global_step += 1
         return metrics
 
@@ -454,16 +520,59 @@ class Trainer:
         wasted peek-batch (§8 B3)."""
         losses = []
         div_every = self.cfg.train.divergence_check_every
-        for batch in self.loader.epoch(epoch):
+        log_every = self.cfg.train.log_every
+        it = iter(self.loader.epoch(epoch))
+        while True:
+            if self.watchdog is not None:
+                # Armed BEFORE the fetch: a wedged input pipeline (dead
+                # prefetch thread, stuck host data op) is exactly the
+                # silent-hang class the watchdog exists for, so the
+                # data wait must be inside the armed window. The first
+                # step gets a 10x allowance: compile time is expected
+                # to dwarf a steady-state step, and a watchdog tuned to
+                # step time must not fire on it.
+                self.watchdog.arm(
+                    step=self.global_step + 1, epoch=epoch,
+                    timeout_s=(self.watchdog.timeout_s * 10
+                               if self._steps_dispatched == 0
+                               else None))
+            # Host time blocked on the (prefetching) loader — the
+            # data_wait goodput bucket. Near-zero when prefetch keeps
+            # up; a hot data_wait is an input-pipeline limiter.
+            with self.telemetry.span("data_wait",
+                                     step=self.global_step + 1):
+                batch = next(it, None)
+            if batch is None:
+                if self.watchdog is not None:
+                    self.watchdog.disarm()
+                break
             metrics = self.train_step(batch)
             if div_every and self.global_step % div_every == 0:
                 # Compiled cross-replica drift check (SURVEY.md §5.2's
                 # "diff the rank logs", formalized).
+                if (self.watchdog is not None
+                        and not self._div_check_compiled):
+                    # The first check jit-compiles the whole-params
+                    # fingerprint program inside the armed window —
+                    # give it the compile allowance too.
+                    self.watchdog.arm(
+                        step=self.global_step, epoch=epoch,
+                        timeout_s=self.watchdog.timeout_s * 10)
+                self._div_check_compiled = True
                 report = self._check_divergence()
                 if report is not None:
                     metrics = {**metrics, "replica_divergence":
                                report["max_divergence"]}
             self.metrics.record(self.global_step, metrics, epoch=epoch)
+            if self.hbm is not None:
+                self.hbm.maybe_sample(self.global_step)
+            if (self.ledger is not None and log_every > 0
+                    and self.global_step % log_every == 0):
+                self.telemetry.event(
+                    "goodput", scope="window", step=self.global_step,
+                    **self.ledger.window_report())
+            if self.watchdog is not None:
+                self.watchdog.disarm()
             losses.append(metrics["loss"])
             if self._agreed_stop():
                 break
@@ -476,6 +585,12 @@ class Trainer:
         max_epochs = max_epochs or self.cfg.train.total_epochs
         summary: dict[str, float] = {}
         t0 = time.perf_counter()
+        self._bind_telemetry()
+        if self.ledger is not None:
+            # Ledger wall-clock starts at the training loop, not at
+            # trainer construction — init/restore time is visible in
+            # the event stream but is not this run's goodput story.
+            self.ledger.reset()
         for epoch in range(self.epochs_run, max_epochs):
             summary = self._run_epoch(epoch)
             if self.rt.is_coordinator:
@@ -518,6 +633,16 @@ class Trainer:
         if self.checkpointer is not None:
             self.checkpointer.wait()
         summary["wall_time_s"] = time.perf_counter() - t0
+        if self.ledger is not None:
+            rep = self.ledger.report()
+            self.telemetry.event("goodput", scope="run",
+                                 step=self.global_step, **rep)
+            summary["goodput"] = rep
+            if self.rt.is_coordinator:
+                logger.info(
+                    "goodput %.1f%% over %.1fs wall (%d steps): %s",
+                    100 * rep["goodput"], rep["wall_s"], rep["steps"],
+                    rep["buckets"])
         return summary
 
     def _arch_meta(self) -> dict:
@@ -573,10 +698,11 @@ class Trainer:
         eval_fn = self._eval_fn
         total = None
         count = 0
-        for b in batches:
-            loss = eval_fn(self.state["params"], b, self.step_rng)
-            total = loss if total is None else total + loss
-            count += 1
-        if count == 0:
-            return float("nan")
-        return float(total) / count
+        with self.telemetry.span("eval", step=self.global_step):
+            for b in batches:
+                loss = eval_fn(self.state["params"], b, self.step_rng)
+                total = loss if total is None else total + loss
+                count += 1
+            if count == 0:
+                return float("nan")
+            return float(total) / count

@@ -15,6 +15,7 @@ SURVEY.md §5.2). Here the checks are compiled collectives:
 
 from __future__ import annotations
 
+import collections
 import logging
 from typing import Any
 
@@ -40,14 +41,24 @@ def _fingerprint(x: jax.Array) -> jax.Array:
 
 
 # jit/shard_map cache: building a fresh closure per call would recompile
-# the whole-params program on every periodic check.
-_DIVERGENCE_FNS: dict = {}
+# the whole-params program on every periodic check. LRU-bounded: the
+# key holds a Mesh (and through the jitted fn, its devices), so an
+# unbounded dict pins every mesh a long test session ever built.
+_DIVERGENCE_FNS: "collections.OrderedDict" = collections.OrderedDict()
+_DIVERGENCE_CACHE_MAX = 8
+
+
+def clear_divergence_cache() -> None:
+    """Drop all cached divergence programs (test isolation hook)."""
+    _DIVERGENCE_FNS.clear()
 
 
 def _divergence_fn(mesh: Mesh, axes: tuple[str, ...],
                    specs_treedef, specs_leaves: tuple):
     key = (mesh, axes, specs_treedef, specs_leaves)
     fn = _DIVERGENCE_FNS.get(key)
+    if fn is not None:
+        _DIVERGENCE_FNS.move_to_end(key)
     if fn is None:
         in_specs = jax.tree_util.tree_unflatten(
             specs_treedef, list(specs_leaves))
@@ -70,6 +81,8 @@ def _divergence_fn(mesh: Mesh, axes: tuple[str, ...],
                                in_specs=(in_specs,),
                                out_specs=out_specs, check_rep=False))
         _DIVERGENCE_FNS[key] = fn
+        while len(_DIVERGENCE_FNS) > _DIVERGENCE_CACHE_MAX:
+            _DIVERGENCE_FNS.popitem(last=False)
     return fn
 
 

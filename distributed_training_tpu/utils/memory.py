@@ -38,6 +38,38 @@ def param_count(params) -> int:
     return sum(int(np.prod(x.shape)) for x in jax.tree.leaves(params))
 
 
+def state_bytes_per_device(tree, shardings) -> int:
+    """Exact per-device residency of a state tree (params + grads'
+    template + optimizer moments) given its shardings: each leaf's
+    bytes divided by the product of the mesh-axis sizes its
+    PartitionSpec shards over — replicated leaves count in full on
+    every device, ``pinned_host``-offloaded leaves count zero (they
+    live in host RAM between steps).
+
+    This is the model-agnostic cross-check the HBM telemetry stream
+    (telemetry/hbm.py) carries alongside ``memory_stats()`` samples:
+    a growing gap between this number and ``bytes_in_use`` is
+    activations/fragmentation, not state."""
+    def leaf_bytes(x, sh) -> int:
+        if getattr(sh, "memory_kind", None) == "pinned_host":
+            return 0
+        nbytes = int(np.prod(x.shape)) * np.dtype(x.dtype).itemsize
+        spec = getattr(sh, "spec", None)
+        if spec is None:
+            return nbytes
+        sizes = dict(zip(sh.mesh.axis_names, sh.mesh.devices.shape))
+        div = 1
+        for part in spec:
+            if part is None:
+                continue
+            for axis in ((part,) if isinstance(part, str) else part):
+                div *= sizes.get(axis, 1)
+        return -(-nbytes // div)
+
+    counted = jax.tree.map(leaf_bytes, tree, shardings)
+    return int(sum(jax.tree.leaves(counted)))
+
+
 @dataclass
 class MemoryEstimate:
     params_gib: float

@@ -10,10 +10,25 @@ a first-class subsystem (SURVEY.md §5.1/§5.5).
 from __future__ import annotations
 
 import logging
+import math
 import time
 from dataclasses import dataclass, field
 
 logger = logging.getLogger(__name__)
+
+
+def sanitize_for_json(value):
+    """Map non-finite floats to null, recursively through dicts/lists
+    — bare NaN/Infinity are not valid JSON and break strict consumers
+    (jq, JSON.parse). Shared by the metrics and telemetry jsonl
+    writers so the two streams stay parseable by the same tools."""
+    if isinstance(value, float) and not math.isfinite(value):
+        return None
+    if isinstance(value, dict):
+        return {k: sanitize_for_json(v) for k, v in value.items()}
+    if isinstance(value, (list, tuple)):
+        return [sanitize_for_json(v) for v in value]
+    return value
 
 # Peak dense bf16 FLOPs per chip. Sources: public TPU spec sheets.
 TPU_PEAK_FLOPS: dict[str, float] = {
@@ -50,7 +65,13 @@ class MetricsLogger:
     ``jsonl_fresh=True`` truncates the file at the first write (a
     from-scratch run in a reused run_dir must not interleave with the
     previous run's rows); resumed runs append, separated by a
-    ``run_start`` marker line carrying the resume step."""
+    ``run_start`` marker line carrying the resume step.
+
+    The first recorded row is flagged ``"warmup": true`` and carries
+    no throughput numbers: the interval from construction to the
+    first record is jit-compile dominated, so the steps/sec window
+    opens at the first row and the second row is the first clean
+    throughput measurement."""
 
     log_every: int = 10
     samples_per_step: int = 0
@@ -62,7 +83,11 @@ class MetricsLogger:
     jsonl_fresh: bool = True
     start_step: int = 0
 
-    _last_time: float = field(default_factory=time.perf_counter)
+    # None until the first record(): the throughput window starts at
+    # the first recorded row, NOT at construction — the gap between
+    # them is jit compile time, which used to fold into the first
+    # row's steps_per_sec and silently understate throughput.
+    _last_time: float | None = field(default=None)
     _last_step: int = 0
     history: list[dict] = field(default_factory=list)
 
@@ -90,14 +115,9 @@ class MetricsLogger:
         if not self.jsonl_path:
             return
         import json
-        import math
-        # Non-finite floats are not valid JSON (bare NaN breaks strict
-        # consumers: jq, JSON.parse, ...) — map them to null.
-        safe = {k: (None if isinstance(v, float)
-                    and not math.isfinite(v) else v)
-                for k, v in entry.items()}
         with open(self.jsonl_path, "a") as f:
-            f.write(json.dumps(safe, allow_nan=False) + "\n")
+            f.write(json.dumps(sanitize_for_json(entry),
+                               allow_nan=False) + "\n")
 
     def record(self, step: int, metrics: dict, epoch: int = 0) -> None:
         if not self.enabled or self.log_every <= 0:
@@ -105,6 +125,21 @@ class MetricsLogger:
         if step % self.log_every != 0:
             return
         now = time.perf_counter()
+        if self._last_time is None:
+            # First row: compile/warmup dominated — no throughput
+            # numbers, flagged so consumers (and the summarizer's
+            # trajectory stats) can exclude it. The clean window
+            # starts here.
+            entry = {"epoch": epoch, "step": step,
+                     "loss": float(metrics.get("loss", float("nan"))),
+                     "warmup": True}
+            self._append(entry)
+            logger.info("step %d | epoch %d | loss %.6f | (warmup "
+                        "row: throughput window starts here)",
+                        step, epoch, entry["loss"])
+            self._last_time = now
+            self._last_step = step
+            return
         dsteps = max(step - self._last_step, 1)
         dt = max(now - self._last_time, 1e-9)
         steps_per_sec = dsteps / dt

@@ -14,19 +14,49 @@ from __future__ import annotations
 import contextlib
 import logging
 import os
+from dataclasses import dataclass
 
 import jax
 
 logger = logging.getLogger(__name__)
 
+# Live profiler-server singleton: jax.profiler.start_server raises on
+# a second call (the port is held), so the server handle is process
+# state and start/stop must be idempotent — multiple subsystems
+# (trainer, bench, an operator's REPL) may each "ensure" the server.
+_SERVER = None
+_SERVER_PORT: int | None = None
 
-def start_server(port: int = 9999) -> None:
+
+def start_server(port: int = 9999):
     """Expose the live profiler (``jax.profiler.start_server``) so
     TensorBoard / XProf can capture a trace from a running job on
     demand — the production idiom for multi-host pods (capture on any
-    worker while training runs)."""
-    jax.profiler.start_server(port)
+    worker while training runs). Idempotent: a second call returns
+    the running server (a port mismatch is logged — the first server
+    keeps its port)."""
+    global _SERVER, _SERVER_PORT
+    if _SERVER is not None:
+        if port != _SERVER_PORT:
+            logger.warning(
+                "profiler server already on port %d; ignoring "
+                "request for port %d", _SERVER_PORT, port)
+        return _SERVER
+    _SERVER = jax.profiler.start_server(port)
+    _SERVER_PORT = port
     logger.info("profiler server listening on port %d", port)
+    return _SERVER
+
+
+def stop_server() -> None:
+    """Stop the live profiler server if running (idempotent)."""
+    global _SERVER, _SERVER_PORT
+    if _SERVER is None:
+        return
+    jax.profiler.stop_server()
+    _SERVER = None
+    _SERVER_PORT = None
+    logger.info("profiler server stopped")
 
 
 @contextlib.contextmanager
@@ -54,10 +84,20 @@ def annotate(name: str):
     return jax.profiler.TraceAnnotation(name)
 
 
-def trace_steps(trainer, batches, logdir: str, warmup: int = 2) -> int:
+@dataclass(frozen=True)
+class TraceResult:
+    """What a bounded trace produced: how many steps were captured and
+    where the artifact tree landed (callers log/store the path — the
+    trace is evidence, not a side effect)."""
+
+    steps: int
+    logdir: str
+
+
+def trace_steps(trainer, batches, logdir: str,
+                warmup: int = 2) -> TraceResult:
     """Profile a short step window: run ``warmup`` steps uncaptured
-    (compile + cache), then trace the remaining batches. Returns the
-    number of traced steps."""
+    (compile + cache), then trace the remaining batches."""
     it = iter(batches)
     done = 0
     for _ in range(warmup):
@@ -71,4 +111,4 @@ def trace_steps(trainer, batches, logdir: str, warmup: int = 2) -> int:
             done += 1
         if done:
             jax.block_until_ready(metrics["loss"])
-    return done
+    return TraceResult(steps=done, logdir=logdir)

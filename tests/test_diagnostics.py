@@ -126,15 +126,39 @@ def test_profiler_trace_writes_artifacts(rt, tmp_path):
     trainer, _ = _tiny_trainer(rt, tmp_path)
     batches = (list(trainer.loader.epoch(0))
                + list(trainer.loader.epoch(1)))
-    n = profiler.trace_steps(trainer, batches, str(tmp_path / "prof"),
-                             warmup=1)
-    assert n == len(batches) - 1
+    res = profiler.trace_steps(trainer, batches,
+                               str(tmp_path / "prof"), warmup=1)
+    assert res.steps == len(batches) - 1
+    assert res.logdir == str(tmp_path / "prof")
     # jax writes a plugins/profile/<date> tree with a .trace.json.gz /
     # .xplane.pb per host
     found = []
     for root, _dirs, files in os.walk(tmp_path / "prof"):
         found += files
     assert found, "profiler produced no artifacts"
+
+
+def test_divergence_fn_cache_bounded_lru(rt):
+    """The compiled-program cache is keyed by mesh/specs and must not
+    grow without bound across meshes in long sessions; clear() resets
+    it for test isolation."""
+    from distributed_training_tpu.utils.diagnostics import (
+        _DIVERGENCE_CACHE_MAX, _DIVERGENCE_FNS, clear_divergence_cache)
+    clear_divergence_cache()
+    assert len(_DIVERGENCE_FNS) == 0
+    # Distinct spec-leaf keys (different param names/specs) force
+    # distinct cache entries on one mesh.
+    for i in range(_DIVERGENCE_CACHE_MAX + 3):
+        params = {f"w{i}": jnp.ones((4, 4))}
+        diagnostics.replica_divergence(params, rt.mesh)
+    assert len(_DIVERGENCE_FNS) <= _DIVERGENCE_CACHE_MAX
+    # LRU: the most recent key is cached — a repeat call hits.
+    before = len(_DIVERGENCE_FNS)
+    diagnostics.replica_divergence(
+        {f"w{_DIVERGENCE_CACHE_MAX + 2}": jnp.ones((4, 4))}, rt.mesh)
+    assert len(_DIVERGENCE_FNS) == before
+    clear_divergence_cache()
+    assert len(_DIVERGENCE_FNS) == 0
 
 
 def test_divergence_with_sharded_params_no_gather():

@@ -1,0 +1,57 @@
+"""utils/profiler.py: server lifecycle idempotence and the bounded
+step-window trace on a stub trainer (no accelerator needed — the CPU
+backend produces real xplane artifacts)."""
+
+import os
+
+from distributed_training_tpu.utils import profiler
+
+
+class _StubTrainer:
+    """Counts train_step calls; no jax work beyond a tiny op so
+    block_until_ready has something real to wait on."""
+
+    def __init__(self):
+        self.calls = 0
+
+    def train_step(self, batch):
+        import jax.numpy as jnp
+        self.calls += 1
+        return {"loss": jnp.asarray(float(batch))}
+
+
+def test_trace_steps_returns_result_with_logdir(tmp_path):
+    trainer = _StubTrainer()
+    logdir = str(tmp_path / "prof")
+    res = profiler.trace_steps(trainer, [1.0, 2.0, 3.0, 4.0], logdir,
+                               warmup=2)
+    assert res == profiler.TraceResult(steps=2, logdir=logdir)
+    assert trainer.calls == 4  # warmup steps ran too
+    found = []
+    for _root, _dirs, files in os.walk(logdir):
+        found += files
+    assert found, "trace produced no artifacts"
+
+
+def test_trace_steps_short_iterator_consumed_by_warmup(tmp_path):
+    trainer = _StubTrainer()
+    res = profiler.trace_steps(trainer, [1.0], str(tmp_path / "p"),
+                               warmup=5)
+    assert res.steps == 0
+    assert trainer.calls == 1
+
+
+def test_start_server_idempotent_and_stop(unused_tcp_port=None):
+    # A second start_server must return the running server, not crash
+    # on the held port; stop_server is safe to call twice.
+    port = 19377
+    s1 = profiler.start_server(port)
+    try:
+        s2 = profiler.start_server(port)
+        assert s1 is s2
+        # A different-port request while running: logged, same server.
+        s3 = profiler.start_server(port + 1)
+        assert s3 is s1
+    finally:
+        profiler.stop_server()
+    profiler.stop_server()  # idempotent no-op

@@ -36,7 +36,7 @@ FIRST_PARTY = ("distributed_training_tpu",)
 STDLIB = set(getattr(sys, "stdlib_module_names", ()))
 
 SKIP_DIRS = {".git", "__pycache__", "outputs", "_build", ".venv",
-             "state", "evidence"}
+             "state", "evidence", "postmortem"}
 
 
 def iter_py_files(root: str = REPO):
