@@ -24,9 +24,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
-import re
 import sys
-from collections import defaultdict
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
     __file__))))
@@ -45,108 +43,13 @@ if "xla_force_host_platform_device_count" not in _flags:
     ).strip()
 os.environ["JAX_PLATFORMS"] = "cpu"
 
-_DTYPE_BYTES = {"f64": 8, "f32": 4, "bf16": 2, "f16": 2, "s32": 4,
-                "u32": 4, "s16": 2, "u16": 2, "s8": 1, "u8": 1,
-                "pred": 1, "s64": 8, "u64": 8}
-
-_COLLECTIVES = ("all-reduce", "all-gather", "reduce-scatter",
-                "collective-permute", "all-to-all")
-
-# One optimized-HLO instruction: "%name = TYPE op(...)" where TYPE is
-# either a single "dt[shape]{layout}" or a tuple "(dt[s], dt[s], ...)"
-# — tuple results are how XLA emits FUSED collectives (e.g. one
-# all-reduce syncing every gradient leaf), so a single-type parser
-# silently undercounts exactly the most important instruction.
-# Async HLO (the TPU compiler's usual form) splits a collective into a
-# '-start'/'-done' pair; counting both would double the count and
-# ~triple the bytes (the start's result tuple aliases operand AND
-# result buffers). Count sync base forms and async '-done' lines —
-# the done's result type is the collective's true output — and let
-# '-start' lines fall through unmatched (the base-form alternative
-# cannot match them: the char after the op name is '-', not '(').
-_OP_LINE = re.compile(
-    r"=\s+(.*?)\s+"
-    r"(all-reduce|all-gather|reduce-scatter|collective-permute|"
-    r"all-to-all)(?:-done)?\(")
-_TYPE = re.compile(r"(\w+)\[([\d,]*)\]")
-
-
-def _bytes_of(dtype: str, shape: str) -> int:
-    n = 1
-    for d in filter(None, shape.split(",")):
-        n *= int(d)
-    return n * _DTYPE_BYTES.get(dtype, 4)
-
-
-# A TPU-pipeline fused reduce-scatter: the executed op is one RS
-# kernel, but its HLO form is a kCustom fusion whose CALLED computation
-# holds an all-reduce + dynamic-slice pair. Count the fusion (output
-# shape = the true bytes moved per receiver) and skip the called
-# computation's body — otherwise the inner all-reduce is double-counted
-# at FULL pre-scatter bytes, which is exactly how the r4 audit misread
-# the TPU grad sync as "all-reduce at 2x optimal traffic".
-_FUSED_RS_LINE = re.compile(
-    r"=\s+(.*?)\s+fusion\([^\n]*kind=kCustom,\s*"
-    r"calls=(%all-reduce-scatter[\w.\-]*)")
-_RS_COMPUTATION = re.compile(r"^(%all-reduce-scatter[\w.\-]*)\s", re.M)
-
-
-def _strip_fused_rs_bodies(text: str, names: set[str]) -> str:
-    """Remove the bodies of the NAMED %all-reduce-scatter called
-    computations so their inner all-reduce/dynamic-slice never reach
-    the parser. Only computations whose calling fusion was actually
-    COUNTED are stripped — a name-based strip with an uncounted caller
-    would make the grad-sync collective vanish from the report
-    entirely (and the zero-collective contract tests pass vacuously)."""
-    out = []
-    for block in re.split(r"\n(?=%|ENTRY)", text):
-        m = _RS_COMPUTATION.match(block)
-        if m and m.group(1) in names:
-            continue
-        out.append(block)
-    return "\n".join(out)
-
-
-def audit_hlo_text(text: str) -> dict:
-    """Parse optimized HLO text → per-collective counts and bytes."""
-    rows = []
-    counted_rs: set[str] = set()
-    for m in _FUSED_RS_LINE.finditer(text):
-        parts = _TYPE.findall(m.group(1))
-        if not parts:
-            continue
-        total = sum(_bytes_of(dt, sh) for dt, sh in parts)
-        big_dt, big_sh = max(parts, key=lambda p: _bytes_of(p[0], p[1]))
-        rows.append({"kind": "reduce-scatter", "dtype": big_dt,
-                     "shape": big_sh or "scalar",
-                     "tuple_arity": len(parts), "bytes": total,
-                     "fused": True})
-        counted_rs.add(m.group(2))
-    text = _strip_fused_rs_bodies(text, counted_rs)
-    for m in _OP_LINE.finditer(text):
-        types, kind = m.group(1), m.group(2)
-        parts = _TYPE.findall(types)
-        if not parts:
-            continue
-        total = sum(_bytes_of(dt, sh) for dt, sh in parts)
-        big_dt, big_sh = max(
-            parts, key=lambda p: _bytes_of(p[0], p[1]))
-        rows.append({"kind": kind, "dtype": big_dt,
-                     "shape": big_sh or "scalar",
-                     "tuple_arity": len(parts),
-                     "bytes": total})
-    by_kind: dict = defaultdict(lambda: {"count": 0, "bytes": 0})
-    for r in rows:
-        by_kind[r["kind"]]["count"] += 1
-        by_kind[r["kind"]]["bytes"] += r["bytes"]
-    return {
-        "total_collectives": len(rows),
-        "by_kind": dict(by_kind),
-        "largest": sorted(rows, key=lambda r: -r["bytes"])[:10],
-        # Full row list: contract tests must scan EVERY collective —
-        # a pathological row ranked 11th would hide from "largest".
-        "rows": rows,
-    }
+# The HLO parser lives in the telemetry library now (stable schema,
+# consumed by trainer-emitted `collectives` events and the multi-host
+# aggregator); this CLI keeps the audit UX. Imported AFTER the env
+# block above — the package import chain pulls in jax.
+from distributed_training_tpu.telemetry.collectives import (  # noqa: E402,F401 — re-exported: contract tests parse HLO via this module
+    audit_hlo_text,
+)
 
 
 def lower_abstract_step(topology: str, n_devices: int, strategy: str,

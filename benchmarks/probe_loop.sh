@@ -44,10 +44,12 @@ cancel()
     bash /root/repo/benchmarks/session_continue.sh >> "$LOG" 2>&1
     session_rc=$?
     echo "$(date -u +%H:%M:%S) HARVEST_DONE rc=$session_rc" >> "$LOG"
-    if [ "$session_rc" -eq 124 ]; then
-      # The session ABANDONED a still-compiling phase and left it the
-      # chip (abandon_timeout.sh). Probing now would contend on the
-      # tunnel and the probe's own timeout-kill is a wedge risk —
+    if [ "$session_rc" -eq 124 ] || [ "$session_rc" -eq 125 ]; then
+      # rc=124: the session ABANDONED a still-compiling phase and left
+      # it the chip (abandon_timeout.sh). rc=125: the session refused
+      # to START because a previous orphan still owns the chip. Either
+      # way an orphan holds the chip — probing now would contend on
+      # the tunnel and the probe's own timeout-kill is a wedge risk —
       # wait for the orphan to actually exit (bounded) before the
       # probe cycle resumes.
       echo "ORPHAN $(date -u +%H:%M:%S)" > "$STATE"

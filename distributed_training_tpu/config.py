@@ -84,6 +84,26 @@ class TrainConfig:
     # Steps between hbm telemetry samples (device.memory_stats() into
     # the event stream). 0 disables.
     hbm_sample_every: int = 0
+    # Cross-host straggler detector (telemetry/straggler.py): every N
+    # optimizer steps all hosts exchange their window step/data_wait
+    # means over a tiny host-level all-gather and flag hosts
+    # persistently above threshold x the cross-host median. Off the
+    # critical path (one small f32 vector per window); auto-disabled
+    # when process_count == 1. 0 disables the exchange entirely.
+    straggler_every: int = 100
+    straggler_threshold: float = 1.5
+    # Consecutive flagged windows before a verdict (one slow window is
+    # noise — host GC, a checkpoint drain; a persistent 2x is a
+    # failing host).
+    straggler_persist: int = 2
+    # One-shot static audit of the compiled step's collective traffic
+    # (telemetry/collectives.py): after the first step the coordinator
+    # lowers+compiles the same program device-less and emits a
+    # `collectives` event (op counts + bytes/step per mesh axis) so
+    # the summarizer can print a comms roofline next to MFU. Costs one
+    # extra (cache-warm trace) compile on the coordinator; only runs
+    # when an event sink is installed.
+    collectives_audit: bool = True
     dataset_size: int = 2048
     learning_rate: float = 1e-3
     device: str = "auto"          # "auto" | "tpu" | "cpu"

@@ -141,6 +141,11 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--nproc", type=int, default=2)
     p.add_argument("--devices-per-proc", type=int, default=4)
     p.add_argument("--log-dir", default="outputs/local_launch")
+    p.add_argument("--summarize", default=None, metavar="RUN_DIR",
+                   help="after a clean exit, render the run dir's "
+                        "merged cross-host telemetry report (each "
+                        "simulated host writes host_<i>/events.jsonl; "
+                        "see docs/observability.md)")
     p.add_argument("cmd", nargs=argparse.REMAINDER,
                    help="-- followed by the python argv to run")
     args = p.parse_args(argv)
@@ -149,7 +154,11 @@ def main(argv: list[str] | None = None) -> int:
         cmd = ["-m", "distributed_training_tpu.train"]
     procs = launch_local(cmd, args.nproc, args.devices_per_proc,
                          log_dir=args.log_dir)
-    return wait(procs)
+    rc = wait(procs)
+    if rc == 0 and args.summarize:
+        from distributed_training_tpu.telemetry import summarize
+        summarize.main([args.summarize])
+    return rc
 
 
 if __name__ == "__main__":

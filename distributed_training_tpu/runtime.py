@@ -26,6 +26,7 @@ import dataclasses
 import logging
 import math
 import os
+import time
 from dataclasses import dataclass
 
 import jax
@@ -136,6 +137,14 @@ class Runtime:
     platform: str
     process_index: int
     process_count: int
+    # Unix time captured right after a cross-host barrier at runtime
+    # setup (initialize_runtime). Because every host leaves the barrier
+    # at (nearly) the same instant, the per-host readings of this one
+    # shared moment let the multi-host aggregator align the hosts'
+    # wall clocks (telemetry/aggregate.py). None for runtimes built
+    # without initialize_runtime (tests, dryruns) and for hosts whose
+    # setup barrier failed — those merge with zero clock correction.
+    clock_sync_unix: float | None = None
 
     @property
     def is_coordinator(self) -> bool:
@@ -173,6 +182,21 @@ class Runtime:
         """Number of distinct data shards (≅ reference world_size for the
         DistributedSampler arithmetic)."""
         return self.spec.dp * self.spec.fsdp
+
+    def clock_sync_record(self) -> dict:
+        """Payload for this host's ``clock_sync`` telemetry event
+        (docs/observability.md): the barrier-anchored timestamp plus
+        process identity. ``t_sync`` is None when the runtime has no
+        barrier-anchored reading (built without initialize_runtime, or
+        the barrier failed): the aggregator only trusts numeric
+        ``t_sync`` values, so these hosts merge with zero clock
+        correction instead of a spurious one computed from startup
+        skew."""
+        return {
+            "t_sync": self.clock_sync_unix,
+            "process_index": self.process_index,
+            "process_count": self.process_count,
+        }
 
     def describe(self) -> str:
         return (f"platform={self.platform} devices={self.num_devices} "
@@ -272,12 +296,36 @@ def initialize_runtime(cfg: Config) -> Runtime:
 
     spec = MeshSpec.resolve(cfg.mesh, len(devices))
     mesh = build_mesh(spec, devices)
+    # Clock-sync sample for multi-host telemetry merging: every host
+    # leaves this barrier at (to collective latency) the same instant,
+    # so the per-host wall-clock readings of that one shared moment
+    # give the offline aggregator each host's clock offset. Skipped
+    # single-process — there is nothing to align.
+    clock_sync_unix: float | None = time.time()
+    if jax.process_count() > 1:
+        try:
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices(
+                "dtt_telemetry_clock_sync")
+            clock_sync_unix = time.time()
+        except Exception as e:  # noqa: BLE001 — a telemetry nicety
+            # must never take down runtime setup (some backends, e.g.
+            # multi-process CPU, lack cross-process computations). NO
+            # t_sync is recorded for this host: an unsynced timestamp
+            # would read as a barrier instant and the aggregator would
+            # correct this host's timeline by what is actually startup
+            # skew. Without one it merges with zero correction.
+            clock_sync_unix = None
+            logger.warning("telemetry clock-sync barrier failed "
+                           "(%s); merged timelines will carry this "
+                           "host's raw clock offset", e)
     rt = Runtime(
         mesh=mesh,
         spec=spec,
         platform=devices[0].platform,
         process_index=jax.process_index(),
         process_count=jax.process_count(),
+        clock_sync_unix=clock_sync_unix,
     )
     logger.info("runtime initialized: %s", rt.describe())
     return rt

@@ -9,13 +9,22 @@ One instrumentation surface, four consumers:
 - ``HangWatchdog`` (watchdog.py) — per-step hang detection with
   faulthandler/memory-stats/event-tail postmortem bundles;
 - ``HBMSampler`` (hbm.py) — periodic ``device.memory_stats()``
-  samples cross-checked against utils/memory.py estimates.
+  samples cross-checked against utils/memory.py estimates;
+- ``StragglerDetector`` (straggler.py) — on-cadence cross-host
+  step/data_wait exchange flagging persistently slow hosts;
+- ``audit_hlo_text`` (collectives.py) — static collective-traffic
+  accounting of a compiled SPMD step (counts + bytes per mesh axis);
+- the multi-host aggregator (aggregate.py) — merges per-host
+  ``host_<i>/events.jsonl`` streams into one clock-aligned report.
 
 ``python -m distributed_training_tpu.telemetry <run_dir>`` renders it
-all (summarize.py). Event schema and bucket definitions:
-docs/observability.md.
+all (summarize.py; multi-host run dirs get the merged report). Event
+schema and bucket definitions: docs/observability.md.
 """
 
+from distributed_training_tpu.telemetry.collectives import (  # noqa: F401
+    audit_hlo_text,
+)
 from distributed_training_tpu.telemetry.events import (  # noqa: F401
     Telemetry,
     current,
@@ -29,6 +38,10 @@ from distributed_training_tpu.telemetry.goodput import (  # noqa: F401
 )
 from distributed_training_tpu.telemetry.hbm import (  # noqa: F401
     HBMSampler,
+)
+from distributed_training_tpu.telemetry.straggler import (  # noqa: F401
+    StragglerDetector,
+    flag_stragglers,
 )
 from distributed_training_tpu.telemetry.watchdog import (  # noqa: F401
     HangWatchdog,

@@ -47,13 +47,21 @@ class Telemetry:
     library code running outside an instrumented entrypoint.
     ``fresh=False`` appends (resumed runs), separated by a
     ``run_start`` marker, mirroring MetricsLogger's semantics.
+
+    ``host_id`` (the jax process index on multi-host runs) stamps a
+    ``host`` field onto EVERY record, so per-host streams stay
+    attributable after the multi-host aggregator merges them into one
+    timeline (telemetry/aggregate.py). None (single-process default)
+    keeps the stream byte-identical to the single-host schema.
     """
 
     def __init__(self, events_jsonl: str | None = None,
                  enabled: bool = True, fresh: bool = True,
-                 tail_events: int = 256, start_step: int = 0):
+                 tail_events: int = 256, start_step: int = 0,
+                 host_id: int | None = None):
         self.enabled = enabled and events_jsonl is not None
         self.events_jsonl = events_jsonl if self.enabled else None
+        self.host_id = host_id
         self._lock = threading.Lock()
         self._tls = threading.local()
         self._tail: collections.deque = collections.deque(
@@ -71,9 +79,11 @@ class Telemetry:
             # durable-on-write for tail readers and postmortems.
             self._fh = open(self.events_jsonl,
                             "w" if fresh else "a", buffering=1)
-            self._fh.write(json.dumps(
-                {"kind": "run_start", "t": time.time(),
-                 "step": start_step}) + "\n")
+            start: dict = {"kind": "run_start", "t": time.time(),
+                           "step": start_step}
+            if self.host_id is not None:
+                start["host"] = self.host_id
+            self._fh.write(json.dumps(start) + "\n")
 
     # -- sinks ------------------------------------------------------------
 
@@ -84,6 +94,8 @@ class Telemetry:
     def _emit(self, rec: dict) -> None:
         if not self.enabled:  # cheap fast path; authoritative below
             return
+        if self.host_id is not None:
+            rec = {**rec, "host": self.host_id}
         safe = sanitize_for_json(rec)
         line = json.dumps(safe, allow_nan=False)
         with self._lock:

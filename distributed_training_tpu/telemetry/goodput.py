@@ -108,3 +108,55 @@ class GoodputLedger:
     def report(self) -> dict:
         """Cumulative report since reset (the run-level summary)."""
         return self._report(self._t0, self._buckets, self._steps)
+
+
+def goodput_of_stream(events: list[dict]) -> dict | None:
+    """Ledger-style report for one host's raw event records.
+
+    Prefer the trainer's run-scope ledger report; fall back to
+    re-aggregating depth-0 spans (a killed run emits no final report,
+    but its spans are all on disk). Shared by the single-run
+    summarizer and the multi-host aggregator (per-host goodput), so
+    the two can never disagree about bucket accounting.
+    """
+    runs = [e for e in events
+            if e.get("kind") == "goodput" and e.get("scope") == "run"]
+    if runs:
+        return {k: runs[-1][k] for k in
+                ("wall_s", "buckets", "steps", "goodput", "mfu_wall",
+                 "mfu_step") if k in runs[-1]}
+    buckets = dict.fromkeys(BUCKETS, 0.0)
+    steps = 0
+    # Wall-clock is summed PER run_start segment: the stream may hold
+    # several sessions (a resume, or an eval appended hours after a
+    # crash — eval.py's fresh=False path), and spanning first-to-last
+    # timestamp across sessions would book the dead time between them
+    # as idle.
+    wall = 0.0
+    t_first = t_last = None
+    for e in events:
+        t = e.get("t")
+        if isinstance(t, (int, float)):
+            if e.get("kind") == "run_start" and t_first is not None:
+                wall += max(t_last - t_first, 0.0)
+                t_first = None
+            t_first = t if t_first is None else t_first
+            t_last = t
+        if e.get("kind") != "span" or e.get("depth", 0) != 0:
+            continue
+        bucket = SPAN_BUCKET.get(e.get("name"))
+        if bucket is None or not isinstance(e.get("dur_s"),
+                                            (int, float)):
+            continue
+        buckets[bucket] += e["dur_s"]
+        steps += 1 if e.get("name") == "step" else 0
+    if t_first is not None:
+        wall += max(t_last - t_first, 0.0)
+    if wall <= 0:
+        return None
+    buckets = {k: round(v, 4) for k, v in buckets.items()}
+    buckets["idle"] = round(max(wall - sum(buckets.values()), 0.0), 4)
+    return {"wall_s": round(wall, 4), "buckets": buckets,
+            "steps": steps,
+            "goodput": round(buckets["step"] / wall, 4),
+            "reconstructed": True}

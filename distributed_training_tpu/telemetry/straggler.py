@@ -1,0 +1,185 @@
+"""Cross-host straggler detection: who is slowing the pod down.
+
+On a multi-host pod every host's compiled step waits for the slowest
+participant's collectives, so local telemetry alone cannot distinguish
+"this host is slow" from "this host is WAITING on a slow host" — the
+blindness the DDP/FSDP characterization study names as the reason
+per-worker skew must be measured, not inferred (arXiv:2505.12832).
+
+The ``StragglerDetector`` runs an on-cadence, off-critical-path
+exchange: every ``every`` optimizer steps each host contributes its
+window-summed host-side ``step`` and ``data_wait`` seconds to a tiny
+jitted all-gather (``multihost_utils.process_allgather`` — one small
+f32 vector, dwarfed by the step's own collectives), then every host
+independently computes the cross-host medians and flags hosts whose
+window mean exceeds ``threshold`` x median. A flag must persist for
+``persist`` consecutive windows before it becomes a verdict — one
+stochastically slow window (host GC, a checkpoint drain) is noise, a
+persistent 2x is a failing host. Verdicts land in the event stream
+(kind ``straggler``) and feed the hang watchdog's context, so a
+postmortem for a collective hang says "host 3 is 2.1x median on
+data_wait" instead of nothing.
+
+The exchange cadence is a function of ``global_step`` only — in
+lockstep on every host, like the trainer's agreed-stop poll — because
+every host must enter the collective at the same loop point or the
+detector itself deadlocks the pod. Disabled when ``process_count == 1``
+(nothing to compare) or ``every == 0``.
+
+``flag_stragglers`` is the shared core: the offline aggregator
+(telemetry/aggregate.py) applies the same rule to merged per-host
+event streams, so a post-hoc skew report and the runtime detector
+cannot disagree about what counts as a straggler.
+"""
+
+from __future__ import annotations
+
+import logging
+
+import numpy as np
+
+from distributed_training_tpu.telemetry import events as _events
+
+logger = logging.getLogger(__name__)
+
+# Metrics exchanged/compared, in payload order.
+METRICS = ("step", "data_wait")
+
+
+def flag_stragglers(per_host: dict, threshold: float = 1.5,
+                    min_gap_s: float = 0.005) -> list[dict]:
+    """Flag hosts persistently above the cross-host median.
+
+    ``per_host``: host id → {"step": mean_s, "data_wait": mean_s}
+    (missing/None metrics are skipped). A host is flagged on a metric
+    when its value is >= ``threshold`` x the median over hosts AND at
+    least ``min_gap_s`` above it — the absolute floor keeps a 3us-vs-
+    1us data_wait (prefetch keeping up everywhere) from reading as a
+    3x straggler. Returns verdict dicts sorted worst-first.
+    """
+    verdicts: list[dict] = []
+    for metric in METRICS:
+        vals = {h: float(d[metric]) for h, d in per_host.items()
+                if isinstance(d.get(metric), (int, float))}
+        if len(vals) < 2:
+            continue
+        med = float(np.median(list(vals.values())))
+        for h, v in vals.items():
+            if med > 0 and v >= threshold * med and v - med >= min_gap_s:
+                ratio = v / med
+                verdicts.append({
+                    "host": h, "metric": metric,
+                    "ratio": round(ratio, 2),
+                    "value_s": round(v, 6),
+                    "median_s": round(med, 6),
+                    "text": (f"host {h} is {ratio:.1f}x median on "
+                             f"{metric} ({v:.3f}s vs {med:.3f}s)"),
+                })
+    return sorted(verdicts, key=lambda v: -v["ratio"])
+
+
+def _default_gather(payload: np.ndarray) -> np.ndarray:
+    """All-gather one small host-level vector: (k,) → (n_hosts, k)."""
+    from jax.experimental import multihost_utils
+    return np.asarray(multihost_utils.process_allgather(payload))
+
+
+class StragglerDetector:
+    """Windowed cross-host step/data_wait exchange + verdicts.
+
+    Trainer contract: ``record_step(step_s, data_wait_s)`` after every
+    optimizer step, then ``maybe_exchange(global_step)`` at the same
+    loop point on every host. ``watchdog_info()`` returns the latest
+    persistent verdicts for postmortem context.
+    """
+
+    def __init__(self, runtime, telemetry=None, every: int = 0,
+                 threshold: float = 1.5, persist: int = 2,
+                 min_gap_s: float = 0.005, gather=None):
+        self.every = int(every)
+        self.threshold = threshold
+        self.persist = max(1, int(persist))
+        self.min_gap_s = min_gap_s
+        self.process_index = runtime.process_index
+        self.process_count = runtime.process_count
+        self.enabled = self.every > 0 and self.process_count > 1
+        self._telemetry = telemetry
+        self._gather = gather or _default_gather
+        # Window accumulators (host-local, reset at each exchange).
+        self._sums = dict.fromkeys(METRICS, 0.0)
+        self._n = 0
+        # (host, metric) → consecutive flagged windows.
+        self._streaks: dict = {}
+        self.last: dict | None = None  # latest exchange summary
+
+    @property
+    def telemetry(self):
+        # Resolve the ambient sink per use (install() may come late).
+        return (self._telemetry if self._telemetry is not None
+                else _events.current())
+
+    def record_step(self, step_s: float, data_wait_s: float) -> None:
+        if not self.enabled:
+            return
+        self._sums["step"] += step_s
+        self._sums["data_wait"] += data_wait_s
+        self._n += 1
+
+    def maybe_exchange(self, global_step: int) -> dict | None:
+        """Exchange + verdict pass, on the step cadence. Returns the
+        summary (also emitted as a ``straggler`` event), or None off
+        cadence / when disabled. The cadence predicate must stay a
+        pure function of ``global_step``: every host has to reach the
+        collective at the same loop point (see module docstring)."""
+        if (not self.enabled or self._n == 0
+                or global_step % self.every != 0):
+            return None
+        payload = np.asarray(
+            [self._sums[m] for m in METRICS] + [float(self._n)],
+            dtype=np.float32)
+        try:
+            table = self._gather(payload)
+        except Exception as e:  # noqa: BLE001 — observability must
+            # not take down the training loop it observes. A backend
+            # without cross-process gathers (multi-process CPU) fails
+            # on EVERY host at the same loop point, so disabling here
+            # is symmetric — no host is left waiting in a collective.
+            logger.warning("straggler exchange failed (%s); detector "
+                           "disabled for the rest of the run", e)
+            self.enabled = False
+            self.telemetry.event("straggler_disabled",
+                                 step=global_step, error=str(e)[:300])
+            return None
+        self._sums = dict.fromkeys(METRICS, 0.0)
+        self._n = 0
+        per_host: dict[int, dict] = {}
+        for h, row in enumerate(np.asarray(table, dtype=np.float64)):
+            n = max(1.0, float(row[len(METRICS)]))
+            per_host[h] = {m: float(row[i]) / n
+                           for i, m in enumerate(METRICS)}
+        verdicts = flag_stragglers(per_host, self.threshold,
+                                   self.min_gap_s)
+        flagged = {(v["host"], v["metric"]) for v in verdicts}
+        self._streaks = {k: self._streaks.get(k, 0) + 1
+                         for k in flagged}
+        persistent = [v for v in verdicts
+                      if self._streaks[(v["host"], v["metric"])]
+                      >= self.persist]
+        summary = {
+            "step": global_step,
+            "per_host": {str(h): {m: round(x, 6)
+                                  for m, x in d.items()}
+                         for h, d in per_host.items()},
+            "verdicts": verdicts,
+            "persistent": [v["text"] for v in persistent],
+        }
+        self.last = summary
+        self.telemetry.event("straggler", **summary)
+        return summary
+
+    def watchdog_info(self) -> dict:
+        """Context for HangWatchdog.set_context: the latest persistent
+        verdicts (empty dict when there is nothing to say)."""
+        if self.last and self.last["persistent"]:
+            return {"straggler": list(self.last["persistent"])}
+        return {}

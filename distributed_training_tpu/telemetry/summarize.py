@@ -19,7 +19,8 @@ import json
 import os
 import sys
 
-from distributed_training_tpu.telemetry.goodput import BUCKETS
+from distributed_training_tpu.telemetry.goodput import (
+    goodput_of_stream)
 
 
 def load_jsonl(path: str) -> list[dict]:
@@ -64,51 +65,20 @@ def _trajectory(rows: list[dict], key: str) -> dict | None:
 
 
 def _goodput(events: list[dict]) -> dict | None:
-    """Prefer the trainer's run-scope ledger report; fall back to
-    re-aggregating depth-0 spans (a killed run emits no final
-    report, but its spans are all on disk)."""
-    runs = [e for e in events
-            if e.get("kind") == "goodput" and e.get("scope") == "run"]
-    if runs:
-        return {k: runs[-1][k] for k in
-                ("wall_s", "buckets", "steps", "goodput", "mfu_wall",
-                 "mfu_step") if k in runs[-1]}
-    from distributed_training_tpu.telemetry.goodput import SPAN_BUCKET
-    buckets = dict.fromkeys(BUCKETS, 0.0)
-    steps = 0
-    # Wall-clock is summed PER run_start segment: the stream may hold
-    # several sessions (a resume, or an eval appended hours after a
-    # crash — eval.py's fresh=False path), and spanning first-to-last
-    # timestamp across sessions would book the dead time between them
-    # as idle.
-    wall = 0.0
-    t_first = t_last = None
-    for e in events:
-        t = e.get("t")
-        if isinstance(t, (int, float)):
-            if e.get("kind") == "run_start" and t_first is not None:
-                wall += max(t_last - t_first, 0.0)
-                t_first = None
-            t_first = t if t_first is None else t_first
-            t_last = t
-        if e.get("kind") != "span" or e.get("depth", 0) != 0:
-            continue
-        bucket = SPAN_BUCKET.get(e.get("name"))
-        if bucket is None or not isinstance(e.get("dur_s"),
-                                            (int, float)):
-            continue
-        buckets[bucket] += e["dur_s"]
-        steps += 1 if e.get("name") == "step" else 0
-    if t_first is not None:
-        wall += max(t_last - t_first, 0.0)
-    if wall <= 0:
+    """Run-scope ledger report, or span reconstruction for killed
+    runs — shared with the multi-host aggregator (goodput.py)."""
+    return goodput_of_stream(events)
+
+
+def _collectives(events: list[dict]) -> dict | None:
+    """Latest static collective-traffic audit (trainer-emitted
+    ``collectives`` event, telemetry/collectives.py schema)."""
+    rows = [e for e in events if e.get("kind") == "collectives"]
+    if not rows:
         return None
-    buckets = {k: round(v, 4) for k, v in buckets.items()}
-    buckets["idle"] = round(max(wall - sum(buckets.values()), 0.0), 4)
-    return {"wall_s": round(wall, 4), "buckets": buckets,
-            "steps": steps,
-            "goodput": round(buckets["step"] / wall, 4),
-            "reconstructed": True}
+    from distributed_training_tpu.telemetry.collectives import (
+        summary_of_event)
+    return summary_of_event(rows[-1])
 
 
 def _hbm(events: list[dict]) -> dict | None:
@@ -169,6 +139,7 @@ def summarize_run(run_dir: str) -> dict:
         "mfu": _trajectory(metrics, "mfu"),
         "goodput": _goodput(events),
         "hbm": _hbm(events),
+        "collectives": _collectives(events),
         "spans": _spans(events),
         "watchdog_firings": [e for e in events
                              if e.get("kind") == "watchdog_fired"],
@@ -217,7 +188,23 @@ def render(summary: dict) -> str:
             line += (f" (state estimate "
                      f"{hbm['estimate_bytes'] / 1024 ** 3:.3f} GiB)")
         lines.append(line)
+    coll = summary.get("collectives")
     spans = summary.get("spans") or {}
+    if coll:
+        from distributed_training_tpu.telemetry.collectives import (
+            render_lines)
+        headline, *axis_lines = render_lines(coll)
+        lines.append(headline)
+        step_agg = spans.get("step")
+        if step_agg and step_agg["count"] and coll["bytes_per_step"]:
+            # The comms roofline next to MFU: bytes the step's
+            # collectives move divided by measured step time — the
+            # interconnect bandwidth the run sustains.
+            mean_step = step_agg["total_s"] / step_agg["count"]
+            lines.append(
+                f"  ~{coll['bytes_per_step'] / mean_step / 1e9:.2f} "
+                f"GB/s sustained over {mean_step * 1e3:.1f}ms steps")
+        lines.extend(axis_lines)
     if spans:
         lines.append("spans (count / total / max):")
         for name in sorted(spans, key=lambda n: -spans[n]["total_s"]):
@@ -236,14 +223,29 @@ def render(summary: dict) -> str:
 def main(argv: list[str] | None = None) -> int:
     p = argparse.ArgumentParser(
         prog="python -m distributed_training_tpu.telemetry",
-        description="Summarize a run_dir's metrics/events streams")
+        description="Summarize a run_dir's metrics/events streams "
+                    "(multi-host run dirs with host_<i>/ subdirs get "
+                    "the merged cross-host report)")
     p.add_argument("run_dir")
     p.add_argument("--json", action="store_true",
                    help="emit the summary as one JSON object")
+    p.add_argument("--write-merged", default=None, metavar="PATH",
+                   help="multi-host only: also write the merged, "
+                        "clock-aligned event timeline as jsonl")
     args = p.parse_args(argv)
     if not os.path.isdir(args.run_dir):
         print(f"not a directory: {args.run_dir}", file=sys.stderr)
         return 2
+    from distributed_training_tpu.telemetry import aggregate
+    if aggregate.is_multihost_run_dir(args.run_dir):
+        summary = aggregate.aggregate_run(args.run_dir)
+        if args.write_merged:
+            aggregate.write_merged(args.run_dir, args.write_merged)
+        if args.json:
+            print(json.dumps(summary))
+        else:
+            print(aggregate.render_multihost(summary))
+        return 0
     summary = summarize_run(args.run_dir)
     if args.json:
         print(json.dumps(summary))

@@ -83,7 +83,9 @@ def write_postmortem(base_dir: str, reason: str,
                       indent=1)
         with open(os.path.join(path, "stacks.txt"), "w") as f:
             faulthandler.dump_traceback(file=f, all_threads=True)
-        with open(os.path.join(path, "events_tail.jsonl"), "w") as f:
+        # noqa'd DTT001: a postmortem COPY of already-emitted records,
+        # not an emission path — host tags are already on the records.
+        with open(os.path.join(path, "events_tail.jsonl"), "w") as f:  # noqa: DTT001
             for rec in events_tail or []:
                 f.write(json.dumps(rec) + "\n")
         # memory_stats queries the backend — the component that may be
@@ -131,6 +133,7 @@ class HangWatchdog:
         self._armed_at: float | None = None
         self._timeout_cur = timeout_s
         self._info: dict = {}
+        self._context: dict = {}
         self._fired = False
         self._stopped = False
         self._poll = poll_s if poll_s is not None else max(
@@ -156,6 +159,15 @@ class HangWatchdog:
             self._armed_at = None
             self._cond.notify()
 
+    def set_context(self, ctx: dict) -> None:
+        """Replace the persistent context merged into every future
+        postmortem (on top of the per-arm info). The trainer feeds the
+        straggler detector's latest verdicts through here, so a
+        postmortem for a collective hang says "host 3 is 2.1x median
+        on data_wait" instead of nothing. Pass {} to clear."""
+        with self._cond:
+            self._context = dict(ctx)
+
     def stop(self) -> None:
         with self._cond:
             self._stopped = True
@@ -168,7 +180,8 @@ class HangWatchdog:
                 if self._stopped:
                     return
                 armed_at, fired = self._armed_at, self._fired
-                timeout, info = self._timeout_cur, dict(self._info)
+                timeout = self._timeout_cur
+                info = {**self._info, **self._context}
                 self._cond.wait(self._poll)
             if (armed_at is None or fired
                     or time.monotonic() - armed_at < timeout):

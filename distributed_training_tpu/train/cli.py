@@ -53,8 +53,16 @@ def main(argv: list[str] | None = None) -> int:
                   rt.process_index)
     if not cfg.train.metrics_jsonl:
         cfg.train.metrics_jsonl = os.path.join(run_dir, "metrics.jsonl")
+    # Multi-host: every process records its OWN event stream under
+    # <run_dir>/host_<i>/ (a central writer would put a network hop in
+    # the instrumentation path, and a dead coordinator would take all
+    # evidence with it). The summarizer auto-detects the layout and
+    # merges (telemetry/aggregate.py). Single-process runs keep the
+    # flat <run_dir>/events.jsonl.
+    host_dir = (run_dir if rt.process_count == 1 else
+                os.path.join(run_dir, f"host_{rt.process_index}"))
     if not cfg.train.events_jsonl:
-        cfg.train.events_jsonl = os.path.join(run_dir, "events.jsonl")
+        cfg.train.events_jsonl = os.path.join(host_dir, "events.jsonl")
     logger.info("config loaded; %s", rt.describe())
     if rt.is_coordinator:
         save_resolved(cfg, os.path.join(run_dir, "resolved_config.yaml"))
@@ -101,20 +109,26 @@ def main(argv: list[str] | None = None) -> int:
     from distributed_training_tpu.utils.preemption import PreemptionGuard
     guard = PreemptionGuard.install()
 
-    # Telemetry: event stream on the coordinator (spans/goodput/hbm —
-    # docs/observability.md), hang watchdog on EVERY process (hangs
-    # are host-specific; each host writes its own postmortem bundle).
+    # Telemetry: an event stream on EVERY process (multi-host runs
+    # write per-host streams the aggregator merges; docs/
+    # observability.md), hang watchdog on every process too (hangs are
+    # host-specific; each host writes its own postmortem bundle).
     resumed = checkpointer.latest_step() is not None
     tel = telemetry_lib.install(telemetry_lib.Telemetry(
         events_jsonl=cfg.train.events_jsonl,
-        enabled=rt.is_coordinator,
+        enabled=True,
         fresh=not resumed,
-        start_step=checkpointer.latest_step() or 0))
+        start_step=checkpointer.latest_step() or 0,
+        host_id=(rt.process_index if rt.process_count > 1 else None)))
+    # Clock-sync record: the runtime captured one barrier-anchored
+    # timestamp per host at setup; emitting it into each stream is
+    # what lets the offline aggregator put N host clocks on one axis.
+    tel.event("clock_sync", **rt.clock_sync_record())
     watchdog = None
     if cfg.train.watchdog_timeout_s > 0:
         watchdog = telemetry_lib.HangWatchdog(
             cfg.train.watchdog_timeout_s,
-            os.path.join(run_dir, "postmortem"),
+            os.path.join(host_dir, "postmortem"),
             telemetry=tel, abort=cfg.train.watchdog_abort)
 
     trainer = Trainer(cfg, rt, model, loader, checkpointer,

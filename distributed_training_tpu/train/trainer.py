@@ -217,6 +217,18 @@ class Trainer:
                     f"only {model_vocab} — set train."
                     "dataset_kwargs.vocab_size to the model's "
                     "vocab (or pick the matching model config)")
+        # Cross-host straggler detector (telemetry/straggler.py):
+        # no-op single-process or with straggler_every=0; on a pod it
+        # exchanges window step/data_wait means every K steps and
+        # flags persistent outliers into the event stream + watchdog
+        # context.
+        from distributed_training_tpu.telemetry.straggler import (
+            StragglerDetector)
+        self.straggler = StragglerDetector(
+            runtime,
+            every=cfg.train.straggler_every,
+            threshold=cfg.train.straggler_threshold,
+            persist=cfg.train.straggler_persist)
         tcfg = cfg.train
         if (tcfg.grad_accum_steps > 1
                 and loader.batch_size % tcfg.grad_accum_steps):
@@ -512,7 +524,49 @@ class Trainer:
                     self.state_shardings["opt_state"])
         self._steps_dispatched += 1
         self.global_step += 1
+        if name == "compile":
+            # One-shot: the program that just compiled is the one the
+            # whole run executes, so its collective traffic is now a
+            # fixed fact worth recording.
+            self._maybe_emit_collectives(batch)
         return metrics
+
+    def collectives_report(self, batch) -> dict:
+        """Static audit of the compiled step's collective traffic
+        (telemetry/collectives.py): lower + compile the SAME jitted
+        step against abstract inputs and walk the optimized HLO. No
+        state is materialized or donated — also valid in abstract/
+        topology mode, where this is how the TPU comms contract is
+        inspected chip-free."""
+        from distributed_training_tpu.telemetry import collectives
+        abstract = state_lib.abstract_state(
+            self.model, self.optimizer, self.init_rng,
+            self._device_state_shardings)
+        text = self._step_fn.lower(
+            abstract, batch, self.step_rng).compile().as_text()
+        rep = collectives.audit_hlo_text(text, mesh=self.rt.mesh)
+        rep["mesh"] = {a: s for a, s in self.rt.spec.as_dict().items()
+                       if s > 1}
+        return rep
+
+    def _maybe_emit_collectives(self, batch) -> None:
+        """Emit the ``collectives`` event after the first step.
+        Coordinator-only (the SPMD program is identical on every
+        host) and only when an event sink is recording — the audit
+        costs a cache-warm trace + compile, which a bench loop
+        without telemetry must not pay."""
+        if not (self.cfg.train.collectives_audit
+                and self.telemetry.enabled
+                and self.rt.is_coordinator):
+            return
+        with self.telemetry.span("collectives_audit"):
+            try:
+                rep = self.collectives_report(batch)
+            except Exception:  # noqa: BLE001 — observability must not
+                # take down the training loop it observes.
+                logger.exception("collectives audit failed; continuing")
+                return
+        self.telemetry.event("collectives", **rep)
 
     def _run_epoch(self, epoch: int) -> dict[str, float]:
         """Parity: Trainer._run_epoch (src/distributed_trainer.py:167-183)
@@ -539,14 +593,27 @@ class Trainer:
             # Host time blocked on the (prefetching) loader — the
             # data_wait goodput bucket. Near-zero when prefetch keeps
             # up; a hot data_wait is an input-pipeline limiter.
+            t_wait0 = time.perf_counter()
             with self.telemetry.span("data_wait",
                                      step=self.global_step + 1):
                 batch = next(it, None)
+            data_wait_s = time.perf_counter() - t_wait0
             if batch is None:
                 if self.watchdog is not None:
                     self.watchdog.disarm()
                 break
+            t_step0 = time.perf_counter()
             metrics = self.train_step(batch)
+            if self.straggler.enabled:
+                self.straggler.record_step(
+                    time.perf_counter() - t_step0, data_wait_s)
+                # The exchange is a collective: its cadence (inside
+                # maybe_exchange) is a pure function of global_step so
+                # every host enters at the same loop point.
+                if (self.straggler.maybe_exchange(self.global_step)
+                        is not None and self.watchdog is not None):
+                    self.watchdog.set_context(
+                        self.straggler.watchdog_info())
             if div_every and self.global_step % div_every == 0:
                 # Compiled cross-replica drift check (SURVEY.md §5.2's
                 # "diff the rank logs", formalized).

@@ -20,6 +20,13 @@ os.environ.setdefault("JAX_ENABLE_X64", "0")
 # its claim-the-chip pkill sweep must never fire against live host
 # processes from a test run.
 os.environ["DTT_BENCH_NO_CLAIM"] = "1"
+# The device-less TPU-topology tests initialize libtpu, which on a
+# non-GCP host (or one whose metadata server answers 403) retries the
+# instance-metadata fetch 30x per variable — minutes of wall-clock at
+# 0% CPU before the init even fails. Skip the metadata query outright:
+# topology descriptors don't need it, and the suite must not wedge on
+# a dead metadata endpoint.
+os.environ.setdefault("TPU_SKIP_MDS_QUERY", "true")
 
 import jax  # noqa: E402
 

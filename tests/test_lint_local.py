@@ -40,7 +40,36 @@ def test_lint_local_catches_violations(tmp_path):
         "x = 1 " + "\n"               # trailing whitespace
         "if x == " + "None:\n"
         "\tpass\n"                    # tab
-        "y = '" + "z" * 120 + "'\n")  # long line
+        "y = '" + "z" * 120 + "'\n"
+        "f = open('events.jsonl', 'w')\n")  # bypasses the event sink
     problems = lint_local.check_file(str(bad))
     codes = {p.split()[1] for p in problems}
-    assert {"E501", "W291", "W191", "E711", "F401"} <= codes, problems
+    assert {"E501", "W291", "W191", "E711", "F401",
+            "DTT001"} <= codes, problems
+
+
+def test_lint_local_jsonl_rule_scoping(tmp_path):
+    """DTT001 scoping: read-mode opens and noqa'd derived-artifact
+    writes pass; the sink modules themselves are exempt by path."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import lint_local
+    finally:
+        sys.path.pop(0)
+    ok = tmp_path / "ok.py"
+    ok.write_text(
+        "rows = open('events.jsonl').read()\n"
+        "art = open('tail.jsonl', 'w')  # noqa: DTT001\n"
+        "bare = open('tail2.jsonl', 'w')  # noqa\n")
+    assert not [p for p in lint_local.check_file(str(ok))
+                if "DTT001" in p]
+    # A noqa for a DIFFERENT code must not disable this rule.
+    other = tmp_path / "other.py"
+    other.write_text("x = open('events.jsonl', 'w')  # noqa: E501\n")
+    assert [p for p in lint_local.check_file(str(other))
+            if "DTT001" in p]
+    # The sink itself writes jsonl by definition.
+    sink = os.path.join(REPO, "distributed_training_tpu",
+                        "telemetry", "events.py")
+    assert not [p for p in lint_local.check_file(sink)
+                if "DTT001" in p]

@@ -34,11 +34,18 @@ def test_compute_mfu_hand_computed():
     assert compute_mfu(275e12, "TPU v4") == pytest.approx(1.0)
 
 
-def test_mfu_entry_arithmetic_hand_computed():
+def test_mfu_entry_arithmetic_hand_computed(monkeypatch):
     """Pin the recorded-entry MFU against by-hand arithmetic: 10 steps
-    in (almost exactly) 2s, 4 samples/step, 1e9 FLOPs/sample, 2
-    devices, v4 peak 275e12 -> mfu = (10 samples/s/chip * 1e9) /
-    275e12."""
+    in exactly 2s of stubbed clock, 4 samples/step, 1e9 FLOPs/sample,
+    2 devices, v4 peak 275e12 -> mfu = (10 samples/s/chip * 1e9) /
+    275e12. The clock is frozen: with a real perf_counter the ms-scale
+    work between the two record() calls (logging I/O, a loaded test
+    host) leaks into the 2s window and the tight tolerance flakes."""
+    from distributed_training_tpu.utils import metrics as metrics_mod
+
+    frozen = metrics_mod.time.perf_counter()
+    monkeypatch.setattr(metrics_mod.time, "perf_counter",
+                        lambda: frozen)
     m = MetricsLogger(log_every=10, samples_per_step=4,
                       flops_per_sample=1e9, num_devices=2,
                       device_kind="TPU v4")

@@ -1,0 +1,569 @@
+"""Multi-host observability: cross-host stream aggregation (clock
+alignment, skew + straggler attribution, per-host goodput), the
+runtime straggler detector, static collective-traffic accounting, and
+the launch/local.py-driven 2-process CPU end-to-end (per-host streams
+-> one merged summary)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from distributed_training_tpu import telemetry
+from distributed_training_tpu.telemetry import aggregate
+from distributed_training_tpu.telemetry.collectives import (
+    audit_hlo_text, parse_replica_groups)
+from distributed_training_tpu.telemetry.straggler import (
+    StragglerDetector, flag_stragglers)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_ambient():
+    telemetry.uninstall()
+    yield
+    telemetry.uninstall()
+
+
+def _read_jsonl(path):
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()
+                and not line.startswith("{torn")]
+
+
+# The injected per-host clock offsets (seconds) and the slow host the
+# aggregate/straggler tests must re-discover from the streams alone.
+OFFSETS = {0: 0.0, 1: 5.0, 2: -3.0, 3: 0.5}
+SLOW_HOST = 3
+
+
+def _pod_dir(tmp_path, offsets=None, steps=10, clock_sync=True):
+    """Synthetic 4-host run dir: host_<i>/events.jsonl streams with
+    injected clock offsets, one slow host (2.5x step, 12x data_wait),
+    a fat checkpoint on host 0, a collectives event on the
+    coordinator, and a torn trailing line (crashed-writer
+    tolerance)."""
+    run_dir = tmp_path / "pod"
+    run_dir.mkdir()
+    with open(run_dir / "metrics.jsonl", "w") as f:
+        for i, loss in ((1, 2.0), (2, 1.5), (3, 1.0)):
+            f.write(json.dumps({"step": i, "loss": loss}) + "\n")
+    for h, off in (offsets if offsets is not None else OFFSETS).items():
+        host_dir = run_dir / f"host_{h}"
+        host_dir.mkdir()
+        with open(host_dir / "events.jsonl", "w") as f:
+            t0 = 1000.0 + off
+            f.write(json.dumps({"kind": "run_start", "t": t0,
+                                "step": 0, "host": h}) + "\n")
+            if clock_sync:
+                f.write(json.dumps(
+                    {"kind": "clock_sync", "t": t0, "t_sync": t0,
+                     "process_index": h, "process_count": 4,
+                     "host": h}) + "\n")
+            t = t0
+            for s in range(1, steps + 1):
+                wait = 0.12 if h == SLOW_HOST else 0.01
+                dur = 0.25 if h == SLOW_HOST else 0.10
+                t += wait
+                f.write(json.dumps(
+                    {"kind": "span", "name": "data_wait", "t": t,
+                     "dur_s": wait, "depth": 0, "step": s,
+                     "host": h}) + "\n")
+                t += dur
+                f.write(json.dumps(
+                    {"kind": "span", "name": "step", "t": t,
+                     "dur_s": dur, "depth": 0, "step": s,
+                     "host": h}) + "\n")
+            # Collective save: host 0 is slow to serialize, everyone
+            # else burns the difference blocked at the barrier.
+            ckpt = 0.30 if h == 0 else 0.05
+            t += ckpt
+            f.write(json.dumps(
+                {"kind": "span", "name": "ckpt_save", "t": t,
+                 "dur_s": ckpt, "depth": 0, "host": h}) + "\n")
+            if h == 0:
+                f.write(json.dumps(
+                    {"kind": "collectives", "t": t, "host": h,
+                     "schema": 1, "total_collectives": 2,
+                     "bytes_per_step": 4096,
+                     "by_kind": {"all-reduce":
+                                 {"count": 2, "bytes": 4096}},
+                     "by_axis": {"dp": {"count": 2, "bytes": 4096}},
+                     "mesh": {"dp": 4}}) + "\n")
+            f.write("{torn line\n")
+    return run_dir
+
+
+# -- clock alignment / merge ----------------------------------------------
+
+
+def test_clock_offsets_recover_injected_skew(tmp_path):
+    streams = aggregate.load_host_streams(str(_pod_dir(tmp_path)))
+    offs = aggregate.clock_offsets(streams)
+    # Offsets are relative to the median host; pairwise differences
+    # must reproduce the injected skew exactly.
+    for h in OFFSETS:
+        assert offs[h] - offs[0] == pytest.approx(
+            OFFSETS[h] - OFFSETS[0], abs=1e-9)
+
+
+def test_merged_timeline_monotonic_and_host_tagged(tmp_path):
+    streams = aggregate.load_host_streams(str(_pod_dir(tmp_path)))
+    merged = aggregate.merge_streams(streams)
+    ts = [r["t"] for r in merged]
+    assert ts == sorted(ts)
+    assert {r["host"] for r in merged} == set(OFFSETS)
+    # After alignment all four run_starts collapse onto (nearly) the
+    # same instant instead of spanning the 8s injected skew.
+    starts = [r["t"] for r in merged if r["kind"] == "run_start"]
+    assert max(starts) - min(starts) < 1e-6
+
+
+def test_streams_without_clock_sync_merge_uncorrected(tmp_path):
+    run_dir = _pod_dir(tmp_path, clock_sync=False)
+    streams = aggregate.load_host_streams(str(run_dir))
+    assert aggregate.clock_offsets(streams) == \
+        {h: 0.0 for h in OFFSETS}
+    merged = aggregate.merge_streams(streams)
+    assert len(merged) == sum(len(s) for s in streams.values())
+
+
+def test_unsynced_clock_record_gets_zero_correction(tmp_path):
+    """A host whose setup barrier failed emits ``t_sync: null``
+    (runtime.clock_sync_record with clock_sync_unix=None): the
+    aggregator must NOT invent a clock offset from it — an unsynced
+    wall-clock reading would be corrected by what is actually startup
+    skew."""
+    from distributed_training_tpu.runtime import fake_cpu_runtime
+
+    rec = fake_cpu_runtime(8).clock_sync_record()
+    assert rec["t_sync"] is None
+    run_dir = _pod_dir(tmp_path)
+    streams = aggregate.load_host_streams(str(run_dir))
+    # Replace host 1's sync reading with the barrier-failed form.
+    for e in streams[1]:
+        if e.get("kind") == "clock_sync":
+            e["t_sync"] = None
+    offs = aggregate.clock_offsets(streams)
+    assert offs[1] == 0.0
+    # The synced hosts still align against their own median.
+    assert offs[0] != 0.0 or offs[2] != 0.0
+
+
+def test_write_merged_round_trips(tmp_path):
+    run_dir = str(_pod_dir(tmp_path))
+    out = os.path.join(run_dir, "merged.jsonl")
+    n = aggregate.write_merged(run_dir, out)
+    rows = _read_jsonl(out)
+    assert len(rows) == n
+    ts = [r["t"] for r in rows]
+    assert ts == sorted(ts)
+
+
+def test_is_multihost_run_dir(tmp_path):
+    assert aggregate.is_multihost_run_dir(str(_pod_dir(tmp_path)))
+    flat = tmp_path / "flat"
+    flat.mkdir()
+    (flat / "events.jsonl").write_text("")
+    assert not aggregate.is_multihost_run_dir(str(flat))
+    # host_<i> dir without a stream does not count either.
+    empty = tmp_path / "empty"
+    (empty / "host_0").mkdir(parents=True)
+    assert not aggregate.is_multihost_run_dir(str(empty))
+
+
+# -- skew / straggler attribution (the acceptance fixture) ----------------
+
+
+def test_skew_report_attributes_slow_host(tmp_path):
+    streams = aggregate.load_host_streams(str(_pod_dir(tmp_path)))
+    skew = aggregate.skew_report(streams)
+    assert skew["step_spread"]["worst_host"] == SLOW_HOST
+    assert skew["step_spread"]["worst"]["slowest_host"] == SLOW_HOST
+    assert skew["steps_compared"] == 10
+    per = skew["per_host"]
+    assert per[SLOW_HOST]["step"] == pytest.approx(0.25)
+    assert per[0]["step"] == pytest.approx(0.10)
+    assert per[SLOW_HOST]["data_wait_total_s"] == pytest.approx(1.2)
+    # Host 0's 0.30s save vs everyone's 0.05s: the fast hosts waited.
+    assert skew["ckpt_barrier_spread_s"] == pytest.approx(0.25)
+
+
+def test_aggregate_run_flags_injected_straggler_and_goodput(tmp_path):
+    summary = aggregate.aggregate_run(str(_pod_dir(tmp_path)))
+    assert summary["multihost"] and summary["hosts"] == [0, 1, 2, 3]
+    # The offline pass must attribute BOTH metrics to the slow host
+    # and nothing to anyone else.
+    offline = summary["stragglers"]["offline"]
+    assert offline and {v["host"] for v in offline} == {SLOW_HOST}
+    assert {v["metric"] for v in offline} == {"step", "data_wait"}
+    # Acceptance: per-host goodput buckets sum to that host's
+    # wall-clock within 5%.
+    for h in summary["hosts"]:
+        gp = summary["goodput_by_host"][str(h)]
+        assert gp is not None
+        assert sum(gp["buckets"].values()) == pytest.approx(
+            gp["wall_s"], rel=0.05)
+    # The slow host shows MORE step time, not more idle (it is slow,
+    # not waiting).
+    slow = summary["goodput_by_host"][str(SLOW_HOST)]
+    fast = summary["goodput_by_host"]["0"]
+    assert slow["buckets"]["step"] > 2 * fast["buckets"]["step"]
+    # The coordinator's collectives audit surfaces in the merged view.
+    assert summary["collectives"]["bytes_per_step"] == 4096
+    assert summary["loss"]["last"] == 1.0
+
+
+def test_render_multihost_names_the_straggler(tmp_path):
+    summary = aggregate.aggregate_run(str(_pod_dir(tmp_path)))
+    text = aggregate.render_multihost(summary)
+    assert f"STRAGGLER (offline): host {SLOW_HOST}" in text
+    assert "goodput by host:" in text
+    assert "checkpoint barrier spread" in text
+    assert "collectives: 0.00 MB/step" in text  # 4096 B rounds down
+
+
+def test_summarizer_cli_autodetects_multihost(tmp_path, capsys):
+    from distributed_training_tpu.telemetry.summarize import main
+    run_dir = str(_pod_dir(tmp_path))
+    assert main([run_dir]) == 0
+    out = capsys.readouterr().out
+    assert "multi-host run:" in out and "STRAGGLER" in out
+    assert main([run_dir, "--json"]) == 0
+    parsed = json.loads(capsys.readouterr().out)
+    assert parsed["multihost"] and parsed["schema"] == 1
+    merged_path = os.path.join(run_dir, "merged.jsonl")
+    assert main([run_dir, "--write-merged", merged_path]) == 0
+    capsys.readouterr()
+    assert os.path.isfile(merged_path)
+
+
+# -- the shared straggler rule --------------------------------------------
+
+
+def test_flag_stragglers_threshold_and_floor():
+    base = {0: {"step": 0.1, "data_wait": 0.01},
+            1: {"step": 0.1, "data_wait": 0.01},
+            2: {"step": 0.25, "data_wait": 0.01}}
+    verdicts = flag_stragglers(base, threshold=1.5)
+    assert [v["host"] for v in verdicts] == [2]
+    assert verdicts[0]["metric"] == "step"
+    assert verdicts[0]["ratio"] == pytest.approx(2.5)
+    # Under threshold: nothing.
+    assert not flag_stragglers(base, threshold=3.0)
+    # Absolute floor: 3us vs 1us data_wait (prefetch keeping up
+    # everywhere) is not a 3x straggler.
+    tiny = {0: {"step": 0.1, "data_wait": 1e-6},
+            1: {"step": 0.1, "data_wait": 1e-6},
+            2: {"step": 0.1, "data_wait": 3e-6}}
+    assert not flag_stragglers(tiny)
+    # Fewer than 2 hosts with data: no verdicts, no crash.
+    assert not flag_stragglers({0: {"step": 0.1, "data_wait": None}})
+
+
+class _RT:
+    def __init__(self, process_index=0, process_count=4):
+        self.process_index = process_index
+        self.process_count = process_count
+
+
+def _table(slow_ratio, n=10.0):
+    """Gathered (hosts, [step_sum, wait_sum, n]) table: host 3 slow."""
+    rows = [[1.0, 0.1, n]] * 3 + [[slow_ratio, 0.1 * slow_ratio, n]]
+    return np.asarray(rows, dtype=np.float32)
+
+
+def test_straggler_detector_disabled_paths(tmp_path):
+    assert not StragglerDetector(_RT(process_count=1), every=10).enabled
+    assert not StragglerDetector(_RT(), every=0).enabled
+    det = StragglerDetector(_RT(process_count=1), every=10,
+                            gather=lambda p: (_ for _ in ()).throw(
+                                AssertionError("gather must not run")))
+    det.record_step(0.1, 0.01)
+    assert det.maybe_exchange(10) is None
+
+
+def test_straggler_detector_persist_gates_verdict(tmp_path):
+    tel = telemetry.Telemetry(
+        events_jsonl=str(tmp_path / "e.jsonl"))
+    tables = iter([_table(2.5), _table(2.5)])
+    det = StragglerDetector(_RT(), telemetry=tel, every=10, persist=2,
+                            gather=lambda p: next(tables))
+    for s in range(1, 21):
+        det.record_step(0.1, 0.01)
+        out = det.maybe_exchange(s)
+        if s == 10:
+            # First flagged window: a verdict candidate, not yet
+            # persistent (one slow window is noise).
+            assert out["verdicts"] and not out["persistent"]
+            assert det.watchdog_info() == {}
+        elif s == 20:
+            assert out["persistent"]
+            assert f"host {SLOW_HOST} is 2.5x median" in \
+                out["persistent"][0]
+            assert "straggler" in det.watchdog_info()
+        else:
+            assert out is None  # off cadence: no gather, no event
+    rows = [r for r in _read_jsonl(str(tmp_path / "e.jsonl"))
+            if r["kind"] == "straggler"]
+    assert len(rows) == 2 and rows[-1]["persistent"]
+
+
+def test_straggler_detector_streak_resets_on_clean_window(tmp_path):
+    tel = telemetry.Telemetry(events_jsonl=str(tmp_path / "e.jsonl"))
+    tables = iter([_table(2.5), _table(1.0), _table(2.5)])
+    det = StragglerDetector(_RT(), telemetry=tel, every=1, persist=2,
+                            gather=lambda p: next(tables))
+    for s in (1, 2, 3):
+        det.record_step(0.1, 0.01)
+        out = det.maybe_exchange(s)
+        # The clean window at s=2 broke the streak: never persistent.
+        assert not out["persistent"]
+
+
+def test_straggler_detector_disables_on_gather_failure(tmp_path):
+    """Observability must not take down the loop it observes: a
+    backend without cross-process gathers (multi-process CPU) fails
+    symmetrically on every host, so the detector disarms for the rest
+    of the run instead of raising into the training loop."""
+    tel = telemetry.Telemetry(events_jsonl=str(tmp_path / "e.jsonl"))
+
+    def broken_gather(payload):
+        raise RuntimeError("Multiprocess computations aren't "
+                           "implemented on the CPU backend.")
+
+    det = StragglerDetector(_RT(), telemetry=tel, every=1,
+                            gather=broken_gather)
+    det.record_step(0.1, 0.01)
+    assert det.maybe_exchange(1) is None
+    assert not det.enabled
+    det.record_step(0.1, 0.01)  # further calls are cheap no-ops
+    assert det.maybe_exchange(2) is None
+    rows = _read_jsonl(str(tmp_path / "e.jsonl"))
+    assert [r["kind"] for r in rows if r["kind"].startswith(
+        "straggler")] == ["straggler_disabled"]
+
+
+def test_straggler_detector_payload_is_window_mean(tmp_path):
+    """The exchange ships window SUMS + count; per-host means must
+    come out right and the window must reset after each exchange."""
+    seen = []
+
+    def gather(payload):
+        seen.append(payload.copy())
+        return np.tile(payload, (4, 1))
+
+    det = StragglerDetector(_RT(), telemetry=telemetry.current(),
+                            every=2, gather=gather)
+    for s in range(1, 5):
+        det.record_step(0.2, 0.05)
+        det.maybe_exchange(s)
+    assert len(seen) == 2
+    for p in seen:  # 2 steps/window x (0.2 step, 0.05 wait), n=2
+        assert p == pytest.approx([0.4, 0.1, 2.0], abs=1e-6)
+
+
+# -- collective-traffic accounting ----------------------------------------
+
+
+def test_collectives_nonzero_for_sharded_zero_for_single():
+    """Acceptance: a jitted step over a sharded mesh reports nonzero
+    collective bytes, attributed to the right mesh axes; a
+    single-device program reports zero."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+    devs = np.array(jax.devices()[:8]).reshape(2, 4)
+    mesh = Mesh(devs, ("dp", "fsdp"))
+    x = jax.device_put(
+        jnp.ones((8, 16), jnp.float32),
+        NamedSharding(mesh, PartitionSpec("dp", "fsdp")))
+    text = jax.jit(lambda v: (v * 2).sum()).lower(x).compile().as_text()
+    rep = audit_hlo_text(text, mesh=mesh)
+    assert rep["schema"] == 1
+    assert rep["total_collectives"] > 0
+    assert rep["bytes_per_step"] > 0
+    # The full reduction communicates over both axes; every byte is
+    # attributed to a known axis (nothing lands in "unknown").
+    assert set(rep["by_axis"]) <= {"dp", "fsdp", "dp+fsdp"}
+    assert sum(v["bytes"] for v in rep["by_axis"].values()) == \
+        rep["bytes_per_step"]
+
+    single = jax.jit(lambda v: v * 2).lower(
+        jnp.ones((8,), jnp.float32)).compile().as_text()
+    rep1 = audit_hlo_text(single)
+    assert rep1["total_collectives"] == 0
+    assert rep1["bytes_per_step"] == 0
+
+
+def test_parse_replica_groups_both_forms():
+    explicit = "replica_groups={{0,1},{2,3}}"
+    assert parse_replica_groups(explicit) == [(0, 1), (2, 3)]
+    # Iota form: 2 groups of 2 over a [2,2] iota transposed — groups
+    # are the COLUMNS of the untransposed arrangement.
+    iota = "replica_groups=[2,2]<=[2,2]T(1,0)"
+    assert parse_replica_groups(iota) == [(0, 2), (1, 3)]
+    assert parse_replica_groups("no groups here") is None
+
+
+def test_trainer_emits_collectives_event(cpu8, tmp_path):
+    """The trainer's one-shot audit after the first (compile) step:
+    a `collectives` event with nonzero dp-axis bytes on the 8-device
+    DDP mesh, consumed by the single-run summarizer."""
+    from distributed_training_tpu.checkpoint import Checkpointer
+    from distributed_training_tpu.config import Config
+    from distributed_training_tpu.data import (
+        ShardedDataLoader, SyntheticRegressionDataset)
+    from distributed_training_tpu.models import build_model
+    from distributed_training_tpu.train.trainer import Trainer
+
+    cfg = Config()
+    cfg.train.batch_size = 8
+    cfg.train.total_epochs = 1
+    cfg.train.save_every = 0
+    cfg.train.log_every = 0
+    cfg.train.dataset_size = 16
+    cfg.train.metrics_jsonl = str(tmp_path / "run" / "metrics.jsonl")
+    cfg.train.events_jsonl = str(tmp_path / "run" / "events.jsonl")
+    telemetry.install(telemetry.Telemetry(
+        events_jsonl=cfg.train.events_jsonl))
+    model = build_model("mlp", input_size=20, output_size=1,
+                        loss="mse")
+    ds = SyntheticRegressionDataset(size=16, in_dim=20, out_dim=1,
+                                    seed=0)
+    loader = ShardedDataLoader(ds, cpu8, batch_size=8)
+    trainer = Trainer(cfg, cpu8, model, loader,
+                      Checkpointer(str(tmp_path / "run" / "ckpt")))
+    trainer.train()
+    events = _read_jsonl(cfg.train.events_jsonl)
+    colls = [e for e in events if e["kind"] == "collectives"]
+    assert len(colls) == 1, "one-shot audit must emit exactly once"
+    rep = colls[0]
+    # DDP grad sync across dp=8: all-reduce traffic on the dp axis.
+    assert rep["bytes_per_step"] > 0
+    assert rep["by_kind"]["all-reduce"]["count"] >= 1
+    assert rep["mesh"] == {"dp": 8}
+    assert set(rep.get("by_axis", {})) == {"dp"}
+    from distributed_training_tpu.telemetry.summarize import (
+        render, summarize_run)
+    summary = summarize_run(str(tmp_path / "run"))
+    assert summary["collectives"]["bytes_per_step"] == \
+        rep["bytes_per_step"]
+    assert "collectives:" in render(summary)
+
+
+def test_trainer_audit_failure_does_not_kill_training(
+        cpu8, tmp_path, monkeypatch):
+    """Observability must not take down the loop it observes: a
+    crashing audit logs and training completes, with no collectives
+    event."""
+    from distributed_training_tpu.checkpoint import Checkpointer
+    from distributed_training_tpu.config import Config
+    from distributed_training_tpu.data import (
+        ShardedDataLoader, SyntheticRegressionDataset)
+    from distributed_training_tpu.models import build_model
+    from distributed_training_tpu.train.trainer import Trainer
+
+    cfg = Config()
+    cfg.train.batch_size = 8
+    cfg.train.total_epochs = 1
+    cfg.train.save_every = 0
+    cfg.train.log_every = 0
+    cfg.train.dataset_size = 16
+    cfg.train.metrics_jsonl = str(tmp_path / "run" / "metrics.jsonl")
+    cfg.train.events_jsonl = str(tmp_path / "run" / "events.jsonl")
+    telemetry.install(telemetry.Telemetry(
+        events_jsonl=cfg.train.events_jsonl))
+    model = build_model("mlp", input_size=20, output_size=1,
+                        loss="mse")
+    ds = SyntheticRegressionDataset(size=16, in_dim=20, out_dim=1,
+                                    seed=0)
+    loader = ShardedDataLoader(ds, cpu8, batch_size=8)
+    trainer = Trainer(cfg, cpu8, model, loader,
+                      Checkpointer(str(tmp_path / "run" / "ckpt")))
+    monkeypatch.setattr(
+        Trainer, "collectives_report",
+        lambda self, batch: (_ for _ in ()).throw(
+            RuntimeError("audit boom")))
+    summary = trainer.train()
+    assert np.isfinite(summary["mean_loss"])
+    events = _read_jsonl(cfg.train.events_jsonl)
+    assert not [e for e in events if e["kind"] == "collectives"]
+
+
+# -- 2-process CPU end-to-end (the real per-host layout) ------------------
+
+
+@pytest.mark.slow
+def test_two_process_run_produces_mergeable_streams(tmp_path, capsys):
+    """launch/local.py drives the real CLI as a simulated 2-host pod:
+    each host writes host_<i>/events.jsonl (host-tagged, with a
+    clock_sync record), the coordinator emits the collectives audit,
+    the straggler exchange runs, and the multi-host summarizer
+    renders one merged report without error."""
+    from distributed_training_tpu.launch import local as launch_local
+
+    out_dir = str(tmp_path / "out")
+    run_dir = os.path.join(out_dir, "default")
+    procs = launch_local.launch_local(
+        [
+            "-m", "distributed_training_tpu.train",
+            f"run.output_dir={out_dir}",
+            f"train.snapshot_path={tmp_path / 'ckpt'}",
+            "train.total_epochs=2",
+            "train.dataset_size=64",
+            "train.batch_size=8",
+            "train.log_every=0",
+            "train.save_every=0",
+            "train.straggler_every=1",
+        ],
+        num_processes=2,
+        devices_per_process=2,
+        log_dir=str(tmp_path / "logs"),
+        env={"JAX_PLATFORMS": "cpu"},
+    )
+    code = launch_local.wait(procs, timeout=420)
+    logs = "\n".join(
+        open(p.log_path).read() for p in procs if p.log_path)
+    if code != 0 and ("Multiprocess computations aren't implemented"
+                      in logs):
+        # Pre-existing container limitation (the seed's 2-process
+        # training test fails on it too, inside orbax's directory
+        # sync): this jax build's CPU backend cannot run ANY
+        # cross-process computation, so no multi-process training path
+        # can execute here. The test stays live for capable backends.
+        pytest.skip("jax CPU backend lacks multiprocess computations "
+                    "in this environment")
+    assert code == 0, f"multi-process run failed:\n{logs[-4000:]}"
+
+    # Per-host layout, every record host-tagged, clock sync present.
+    streams = aggregate.load_host_streams(run_dir)
+    assert sorted(streams) == [0, 1]
+    for h, events in streams.items():
+        assert all(e.get("host") == h for e in events)
+        kinds = {e["kind"] for e in events}
+        assert "clock_sync" in kinds and "span" in kinds
+        # The exchange ran on BOTH hosts (every host computes the
+        # same verdicts from the same gathered table).
+        assert "straggler" in kinds
+    # Coordinator-only one-shot collectives audit: 4-device DDP mesh
+    # means nonzero all-reduce bytes.
+    colls = [e for e in streams[0]
+             if e["kind"] == "collectives"]
+    assert len(colls) == 1 and colls[0]["bytes_per_step"] > 0
+    assert not [e for e in streams[1] if e["kind"] == "collectives"]
+
+    # The merged report renders from the real run dir.
+    from distributed_training_tpu.telemetry.summarize import main
+    assert main([run_dir]) == 0
+    out = capsys.readouterr().out
+    assert "multi-host run:" in out and "goodput by host:" in out
+    assert main([run_dir, "--json"]) == 0
+    summary = json.loads(capsys.readouterr().out)
+    assert summary["hosts"] == [0, 1]
+    for h in ("0", "1"):
+        gp = summary["goodput_by_host"][h]
+        assert sum(gp["buckets"].values()) == pytest.approx(
+            gp["wall_s"], rel=0.05)
+    assert summary["collectives"]["bytes_per_step"] > 0

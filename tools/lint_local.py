@@ -17,6 +17,14 @@ is red when a violation lands:
 - isort subset (profile=black): within each contiguous top-of-file
   import block, `import`-group ordering stdlib < third-party <
   first-party and alphabetical order inside each group.
+- DTT001 (repo rule, not flake8): a write-mode ``open`` of a
+  ``*jsonl*`` stream anywhere outside the telemetry/metrics sinks.
+  Event emission MUST go through ``telemetry/events.py`` — a bare
+  jsonl write skips host tagging and the multi-host aggregator
+  (telemetry/aggregate.py) silently mis-attributes the records.
+  ``tests/`` is exempt (fixtures hand-write synthetic streams);
+  derived artifacts (postmortem event tails, merged timelines) carry
+  an inline ``# noqa``.
 - black / mypy: NOT locally enforceable without the tools; they
   remain CI-only. This file documents that boundary explicitly
   instead of pretending coverage.
@@ -26,6 +34,7 @@ from __future__ import annotations
 
 import ast
 import os
+import re
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -37,6 +46,16 @@ STDLIB = set(getattr(sys, "stdlib_module_names", ()))
 
 SKIP_DIRS = {".git", "__pycache__", "outputs", "_build", ".venv",
              "state", "evidence", "postmortem"}
+
+# The only modules allowed to open a jsonl stream for writing: the
+# event sink (host tagging lives there) and the metrics logger (its
+# own sink, predating telemetry; metrics.jsonl is not an event
+# stream). Everything else must emit through telemetry/events.py.
+JSONL_SINKS = {
+    os.path.join("distributed_training_tpu", "telemetry", "events.py"),
+    os.path.join("distributed_training_tpu", "utils", "metrics.py"),
+}
+_WRITE_CHARS = set("wax+")
 
 
 def iter_py_files(root: str = REPO):
@@ -131,6 +150,43 @@ def check_file(path: str) -> list[str]:
                 problems.append(
                     f"{rel}:{lineno}: F401 '{name}' imported but "
                     "unused")
+
+    # DTT001: bare jsonl emission. Flag write-mode open() calls whose
+    # file argument mentions "jsonl" outside the sink modules — all
+    # event emission must go through telemetry/events.py or host
+    # tagging (and with it multi-host aggregation) silently breaks.
+    # tests/ hand-writes fixture streams by design; derived artifacts
+    # opt out with an inline `# noqa`.
+    if rel not in JSONL_SINKS and not rel.startswith("tests" + os.sep):
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id == "open" and node.args):
+                continue
+            mode = node.args[1] if len(node.args) >= 2 else None
+            for kw in node.keywords:
+                if kw.arg == "mode":
+                    mode = kw.value
+            if not (isinstance(mode, ast.Constant)
+                    and isinstance(mode.value, str)
+                    and set(mode.value) & _WRITE_CHARS):
+                continue
+            target = ast.get_source_segment(text, node.args[0]) or ""
+            if "jsonl" not in target.lower():
+                continue
+            # flake8 noqa semantics: a bare `# noqa` suppresses
+            # everything, `# noqa: CODE[,CODE]` only the named codes —
+            # an unrelated `# noqa: E501` must not disable this rule.
+            if node.lineno - 1 < len(lines):
+                m = re.search(r"#\s*noqa(?::\s*([A-Z0-9, ]+))?",
+                              lines[node.lineno - 1])
+                if m and (m.group(1) is None
+                          or "DTT001" in m.group(1)):
+                    continue
+            problems.append(
+                f"{rel}:{node.lineno}: DTT001 write-mode open() of a "
+                "jsonl stream outside the telemetry sink — emit "
+                "through telemetry/events.py (host tagging)")
 
     # isort subset (default/black-profile semantics): sections ordered
     # future < stdlib < third-party < first-party < relative; within a
