@@ -562,8 +562,10 @@ def _claim_chip() -> None:
         try:
             subprocess.run(["pkill", "-9", "-f", pattern],
                            capture_output=True, timeout=10)
-        except Exception:  # noqa: BLE001 — never let cleanup kill us
-            pass
+        except Exception as e:  # noqa: BLE001 — never let cleanup
+            # kill us; but say so (DTT002: no silent swallows).
+            print(f"[bench] claim-chip pkill '{pattern}' failed: "
+                  f"{type(e).__name__}: {e}", file=sys.stderr)
     deadline = time.monotonic() + 15
     while time.monotonic() < deadline:
         try:
@@ -605,8 +607,11 @@ def main() -> None:
                 os.path.join(CHILD_LOG_DIR, "postmortem"),
                 f"bench child still running at the parent's "
                 f"{RUN_TIMEOUT_S}s deadline (abandoned-child path)")
-        except Exception:  # noqa: BLE001
-            pass
+        except Exception as e:  # noqa: BLE001 — the bench must run
+            # even without its safety net; but say which net is gone
+            # (DTT002: no silent swallows).
+            print(f"[bench] child postmortem watchdog not armed: "
+                  f"{type(e).__name__}: {e}", file=sys.stderr)
     if not child_mode:
         _claim_chip()
         probe_backend()

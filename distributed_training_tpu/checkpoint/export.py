@@ -47,7 +47,12 @@ def restore_step_local(ckpt_dir: str, step: int | None = None
 
     dev = jax.devices()[0]
     ckptr = ocp.PyTreeCheckpointer()
-    tree = ckptr.metadata(state_path).item_metadata.tree
+    # Orbax API drift: PyTreeCheckpointer.metadata() returns the tree
+    # metadata directly on the version pinned here; newer releases
+    # wrap it in StepMetadata(item_metadata=...). Accept both.
+    meta = ckptr.metadata(state_path)
+    item = getattr(meta, "item_metadata", None)
+    tree = getattr(item, "tree", item) if item is not None else meta
     restore_args = jax.tree.map(
         lambda _m: ocp.ArrayRestoreArgs(
             sharding=SingleDeviceSharding(dev)), tree)

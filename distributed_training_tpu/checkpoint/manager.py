@@ -17,16 +17,32 @@ fsdp_strategy.py:28-46) with one path that is correct for every layout:
   load-on-startup contract (src/distributed_trainer.py:97-105) but
   restores each shard directly to its device (topology-change-tolerant:
   Orbax reshards when the mesh differs from the one that saved).
+- **integrity + fallback** (resilience/integrity.py): every committed
+  save gets a per-file checksum manifest; ``restore_latest`` verifies
+  and, on mismatch or an orbax restore failure, QUARANTINES the bad
+  step (``step_<N>.corrupt`` + ``ckpt_quarantined`` event) and falls
+  back to the next-older good checkpoint instead of crashing the run.
+  A run with no restorable checkpoint starts fresh — the crash-
+  restart-resume contract never dies on a half-written artifact.
+
+Use as a context manager (the train CLI does): ``__exit__`` runs
+``wait()`` + ``close()`` on EVERY exit path, so an in-flight async
+save is never dropped — not on preemption, not on a fault-injected
+crash.
 """
 
 from __future__ import annotations
 
 import logging
+import os
+import threading
 from typing import Any
 
+import jax
 import orbax.checkpoint as ocp
 
 from distributed_training_tpu import telemetry
+from distributed_training_tpu.resilience import integrity
 
 logger = logging.getLogger(__name__)
 
@@ -35,14 +51,36 @@ class Checkpointer:
     """Thin lifecycle wrapper over ``ocp.CheckpointManager``."""
 
     def __init__(self, directory: str, max_to_keep: int = 3,
-                 async_save: bool = True) -> None:
+                 async_save: bool = True, verify_integrity: bool = True,
+                 fault_injector=None) -> None:
         self.directory = directory
+        self.verify_integrity = verify_integrity
+        self._async = async_save
+        self._injector = fault_injector
+        # Steps saved but not yet manifested. With async saves a step
+        # is only safe to hash once COMMITTED (orbax finalizes with an
+        # atomic rename); commit points are "the next save() returns"
+        # (orbax drains the previous save first) and wait().
+        self._pending_manifest: set[int] = set()
+        self._manifest_thread: threading.Thread | None = None
         options = ocp.CheckpointManagerOptions(
             max_to_keep=max_to_keep,
             create=True,
             enable_async_checkpointing=async_save,
         )
         self._mgr = ocp.CheckpointManager(directory, options=options)
+
+    # -- lifecycle (context manager: never drop an in-flight save) ---------
+
+    def __enter__(self) -> "Checkpointer":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        try:
+            self.wait()
+        finally:
+            self.close()
+        return False
 
     # -- save --------------------------------------------------------------
 
@@ -65,7 +103,64 @@ class Checkpointer:
         if saved:
             logger.info("checkpoint saved at step %d -> %s", step,
                         self.directory)
+            self._pending_manifest.add(step)
+        # Everything except a still-draining async ``step`` is now
+        # committed (orbax waits for the previous async save before
+        # starting a new one) — manifest it.
+        self._flush_manifests(in_flight=step if self._async else None)
+        # Coordinator only: on shared storage N hosts XOR-flipping the
+        # same bytes would undo each other (even count = no damage).
+        # Filesystem-only hook, no collective — safe to gate by host.
+        if (self._injector is not None and saved
+                and jax.process_index() == 0):
+            self._injector.on_checkpoint_saved(step, self.directory)
         return bool(saved)
+
+    def _flush_manifests(self, in_flight: int | None = None,
+                         blocking: bool = False) -> None:
+        """Write checksum manifests for every pending COMMITTED step.
+        Process 0 only — the manifest lives on the shared filesystem
+        and N hosts hashing the same files is pure waste.
+
+        Hashing a multi-host checkpoint is a full re-read of the
+        step's bytes; doing it inline in save() would stall every
+        host's step loop behind the coordinator. So the hash runs in
+        a background thread, one flush at a time (the join below keeps
+        manifests landing in step order), joined for real at wait()/
+        ``__exit__``. With a fault injector armed it stays synchronous
+        — ``on_checkpoint_saved`` must only ever corrupt bytes whose
+        manifest already exists, or verification would bless the
+        damage."""
+        committed = sorted(s for s in self._pending_manifest
+                           if s != in_flight)
+        self._pending_manifest.difference_update(committed)
+        if blocking:
+            # A blocking flush must also drain an in-flight background
+            # hash even when nothing NEW is pending.
+            self._join_manifest_flusher()
+        if (not committed or not self.verify_integrity
+                or jax.process_index() != 0):
+            return
+        self._join_manifest_flusher()
+
+        def _write(steps=tuple(committed)) -> None:
+            for step in steps:
+                step_dir = os.path.join(self.directory, str(step))
+                if os.path.isdir(step_dir):
+                    integrity.write_manifest(step_dir)
+
+        if blocking or self._injector is not None:
+            _write()
+        else:
+            self._manifest_thread = threading.Thread(
+                target=_write, name="ckpt-manifest", daemon=True)
+            self._manifest_thread.start()
+
+    def _join_manifest_flusher(self) -> None:
+        t = self._manifest_thread
+        if t is not None:
+            t.join()
+            self._manifest_thread = None
 
     # -- restore -----------------------------------------------------------
 
@@ -74,30 +169,70 @@ class Checkpointer:
 
     def restore_latest(self, abstract_state: Any
                        ) -> tuple[Any, dict] | None:
-        """Restore the newest checkpoint into the given sharded layout,
-        or None if no checkpoint exists (fresh start — parity:
-        src/distributed_trainer.py:100-101)."""
-        step = self._mgr.latest_step()
-        if step is None:
-            return None
-        with telemetry.span("ckpt_restore", step=step):
-            restored = self._mgr.restore(
-                step,
-                args=ocp.args.Composite(
-                    state=ocp.args.StandardRestore(abstract_state),
-                    meta=ocp.args.JsonRestore(),
-                ),
-            )
-        logger.info("restored checkpoint step %d from %s", step,
-                    self.directory)
-        return restored["state"], dict(restored["meta"] or {})
+        """Restore the newest GOOD checkpoint into the given sharded
+        layout, or None if none is restorable (fresh start — parity:
+        src/distributed_trainer.py:100-101).
+
+        Fallback chain: a step that fails manifest verification or
+        raises during the orbax restore is quarantined (rename to
+        ``step_<N>.corrupt`` + ``ckpt_quarantined`` event — bytes are
+        preserved for forensics) and the next-older step is tried.
+        Bounded by the number of checkpoints on disk."""
+        while True:
+            step = self._mgr.latest_step()
+            if step is None:
+                return None
+            step_dir = os.path.join(self.directory, str(step))
+            if self.verify_integrity:
+                verified, problems = integrity.verify_manifest(step_dir)
+                if problems:
+                    self._quarantine(step, problems)
+                    continue
+                if not verified:
+                    logger.warning(
+                        "checkpoint step %d has no integrity manifest "
+                        "(pre-manifest save); restoring unverified",
+                        step)
+            try:
+                with telemetry.span("ckpt_restore", step=step):
+                    restored = self._mgr.restore(
+                        step,
+                        args=ocp.args.Composite(
+                            state=ocp.args.StandardRestore(
+                                abstract_state),
+                            meta=ocp.args.JsonRestore(),
+                        ),
+                    )
+            except Exception as e:  # noqa: BLE001 — fallback chain:
+                # quarantine (rename, nothing deleted) + try the next
+                # older step; an abstract-tree bug would surface as
+                # every step failing, loudly, with the dirs preserved.
+                logger.exception(
+                    "orbax restore of step %d failed; quarantining "
+                    "and falling back", step)
+                self._quarantine(
+                    step, [f"restore raised {type(e).__name__}: {e}"])
+                continue
+            logger.info("restored checkpoint step %d from %s", step,
+                        self.directory)
+            return restored["state"], dict(restored["meta"] or {})
+
+    def _quarantine(self, step: int, problems: list[str]) -> None:
+        integrity.quarantine_step(self.directory, step,
+                                  problems=problems)
+        # The manager caches its step list; after the rename it must
+        # rescan or latest_step() keeps returning the condemned step.
+        self._mgr.reload()
 
     # -- lifecycle ---------------------------------------------------------
 
     def wait(self) -> None:
-        """Block until async saves are durable (call before exit)."""
+        """Block until async saves are durable — manifests included
+        (call before exit)."""
         with telemetry.span("ckpt_wait"):
             self._mgr.wait_until_finished()
+        self._flush_manifests(blocking=True)
 
     def close(self) -> None:
+        self._join_manifest_flusher()
         self._mgr.close()

@@ -152,6 +152,16 @@ class TrainConfig:
     # slower than a few seconds.
     stop_poll_every: int = 8
     profile_dir: str = ""         # non-empty → jax.profiler traces here
+    # Deterministic fault injection (resilience/faults.py): e.g.
+    # "crash@40,sigterm@80,corrupt_ckpt@120,data_stall@60:500ms".
+    # Every trigger is a pure function of the global step (multi-host
+    # safe); faults are one-shot across restarts unless marked
+    # ":always". Empty disables. Grammar: docs/robustness.md.
+    fault_plan: str = ""
+    # Transient batch-assembly/IO errors are retried this many times
+    # (short exponential backoff, `data_retry` telemetry event) before
+    # the step loop is allowed to die. 0 fails on the first blip.
+    data_retries: int = 2
 
 
 @dataclass

@@ -16,8 +16,10 @@ contributes rows ``[s*b, (s+1)*b)`` of the global batch of size
 
 from __future__ import annotations
 
+import logging
 import queue
 import threading
+import time
 from typing import Iterator, Mapping
 
 import jax
@@ -26,6 +28,15 @@ import numpy as np
 from distributed_training_tpu import telemetry
 from distributed_training_tpu.data.sampler import DistributedShardSampler
 from distributed_training_tpu.runtime import Runtime
+
+logger = logging.getLogger(__name__)
+
+# The retryable input-pipeline failure class: host-side IO blips
+# (network filesystems, object stores; TimeoutError is an OSError
+# subclass) and injected transients (resilience/faults.py::
+# InjectedDataError subclasses OSError). A ValueError/KeyError stays
+# fatal — malformed data won't improve on the second read.
+TRANSIENT_DATA_ERRORS = (OSError,)
 
 
 class ShardedDataLoader:
@@ -40,7 +51,8 @@ class ShardedDataLoader:
     def __init__(self, dataset, runtime: Runtime, batch_size: int,
                  shuffle: bool = True, seed: int = 0,
                  drop_last: bool = False, max_steps_per_epoch: int = 0,
-                 prefetch_depth: int = 2):
+                 prefetch_depth: int = 2, data_retries: int = 2,
+                 fault_injector=None):
         if batch_size <= 0:
             raise ValueError(f"batch_size must be > 0, got {batch_size}")
         self.dataset = dataset
@@ -60,6 +72,10 @@ class ShardedDataLoader:
             self.steps_per_epoch = min(self.steps_per_epoch,
                                        max_steps_per_epoch)
         self.prefetch_depth = prefetch_depth
+        # Transient-failure budget per batch (see _assemble_with_retry)
+        # and the deterministic fault hook (resilience/faults.py).
+        self.data_retries = data_retries
+        self._faults = fault_injector
 
     def _epoch_shard_orders(self, epoch: int) -> np.ndarray:
         """(num_shards, num_samples) index matrix for this epoch, with
@@ -99,6 +115,48 @@ class ShardedDataLoader:
                 global_shape, sharding, cb)
         return out
 
+    def _assemble_with_retry(self, rows_by_shard: np.ndarray, *,
+                             epoch: int, step_in_epoch: int
+                             ) -> dict[str, jax.Array]:
+        """``_assemble`` with a bounded transient-failure budget.
+
+        A single IO blip (network filesystem hiccup, object-store 5xx)
+        must not kill a step loop that a supervisor would then pay a
+        whole restart-and-resume cycle for: retry ``data_retries``
+        times with short exponential backoff, emitting a ``data_retry``
+        telemetry event per attempt, then re-raise (a blip that
+        persists IS an incident and should surface).
+
+        The deterministic fault hook runs INSIDE the retried block, so
+        an injected transient (``data_error@N``) exercises exactly the
+        real recovery path. The hook's step key is the loader's own
+        deterministic batch counter (``epoch * steps_per_epoch +
+        step_in_epoch + 1`` — the optimizer's global step whenever
+        epochs are replayed from their start, which is how the trainer
+        resumes)."""
+        fault_step = epoch * self.steps_per_epoch + step_in_epoch + 1
+        attempt = 0
+        while True:
+            try:
+                if self._faults is not None:
+                    self._faults.on_data(fault_step)
+                return self._assemble(rows_by_shard)
+            except TRANSIENT_DATA_ERRORS as e:
+                attempt += 1
+                if attempt > self.data_retries:
+                    raise
+                delay = min(2.0, 0.05 * 2 ** (attempt - 1))
+                logger.warning(
+                    "transient data error (attempt %d/%d, retrying "
+                    "in %.2fs): %s: %s", attempt, self.data_retries,
+                    delay, type(e).__name__, e)
+                telemetry.event(
+                    "data_retry", attempt=attempt,
+                    retries=self.data_retries, epoch=epoch,
+                    step_in_epoch=step_in_epoch, backoff_s=delay,
+                    error=f"{type(e).__name__}: {e}")
+                time.sleep(delay)
+
     def epoch(self, epoch: int) -> Iterator[Mapping[str, jax.Array]]:
         """Iterate one epoch's batches (device-sharded), with background
         host-side prefetch replacing DataLoader worker processes."""
@@ -115,7 +173,8 @@ class ShardedDataLoader:
                 # doesn't stay open while the consumer trains.
                 with telemetry.span("data_assemble",
                                     step_in_epoch=step):
-                    batch = self._assemble(orders[:, sl])
+                    batch = self._assemble_with_retry(
+                        orders[:, sl], epoch=epoch, step_in_epoch=step)
                 yield batch
 
         if self.prefetch_depth > 0:

@@ -17,8 +17,10 @@ devices_per_process=4)`` on any machine.
 from __future__ import annotations
 
 import argparse
+import contextlib
 import logging
 import os
+import signal
 import socket
 import subprocess
 import sys
@@ -97,9 +99,62 @@ def launch_local(
     return procs
 
 
+# Set by _forward_signals' handler: the LAUNCHER itself was told to
+# stop. The supervisor checks it so a preempted launcher tears down
+# (clean child saves, then exit) instead of restarting the job the
+# infrastructure just asked it to release.
+_launcher_signaled: bool = False
+
+
+@contextlib.contextmanager
+def _forward_signals(procs: list[LocalProcess],
+                     signums=(signal.SIGTERM, signal.SIGINT)):
+    """While waiting, forward SIGTERM/SIGINT to the children instead
+    of dying around them: when the LAUNCHER is preempted, the workers'
+    ``PreemptionGuard`` must still fire (clean final save) — without
+    the forward, the launcher exits and the orphaned workers never see
+    the signal. The handler only forwards; teardown happens naturally
+    when the (now cleanly exiting) children are reaped. No-op when not
+    on the main thread (signal.signal would raise there)."""
+    def handler(signum, frame):
+        del frame
+        global _launcher_signaled
+        _launcher_signaled = True
+        logger.warning("launcher got %s — forwarding to %d child "
+                       "process(es)", signal.Signals(signum).name,
+                       len(procs))
+        for lp in procs:
+            if lp.proc.poll() is None:
+                try:
+                    lp.proc.send_signal(signum)
+                except (ProcessLookupError, OSError):
+                    continue  # already reaped/exiting
+
+    prev: dict[int, object] = {}
+    try:
+        for s in signums:
+            prev[s] = signal.signal(s, handler)
+    except ValueError:  # not the main thread: nothing to forward
+        yield
+        return
+    try:
+        yield
+    finally:
+        for s, p in prev.items():
+            signal.signal(s, p)
+
+
 def wait(procs: list[LocalProcess], timeout: float | None = None) -> int:
     """Wait for all processes; kill the group on first failure (the
-    fail-fast behavior torchrun provides). Returns max exit code."""
+    fail-fast behavior torchrun provides). Returns max exit code.
+    SIGTERM/SIGINT delivered to the launcher while waiting are
+    forwarded to the children first (see ``_forward_signals``)."""
+    with _forward_signals(procs):
+        return _wait_inner(procs, timeout)
+
+
+def _wait_inner(procs: list[LocalProcess],
+                timeout: float | None = None) -> int:
     deadline = None if timeout is None else time.monotonic() + timeout
     pending = list(procs)
     worst = 0
@@ -146,19 +201,74 @@ def main(argv: list[str] | None = None) -> int:
                         "merged cross-host telemetry report (each "
                         "simulated host writes host_<i>/events.jsonl; "
                         "see docs/observability.md)")
+    p.add_argument("--supervise", action="store_true",
+                   help="restart dead training processes with backoff "
+                        "(resilience/supervisor.py): exits are "
+                        "classified (completed/preempted/watchdog-"
+                        "abort/crash) and a restart that advances the "
+                        "checkpoint refunds the retry budget, so a "
+                        "crash-loop gives up fast — docs/robustness.md")
+    p.add_argument("--max-restarts", type=int, default=3,
+                   help="retry budget between checkpoint advances")
+    p.add_argument("--backoff-base-s", type=float, default=1.0,
+                   help="first restart delay; doubles per consecutive "
+                        "non-advancing failure (jittered, capped)")
+    p.add_argument("--ckpt-dir", default=None, metavar="DIR",
+                   help="checkpoint dir to watch for progress-based "
+                        "budget refunds (pass the run's "
+                        "train.snapshot_path; without it every "
+                        "failure burns budget)")
     p.add_argument("cmd", nargs=argparse.REMAINDER,
                    help="-- followed by the python argv to run")
     args = p.parse_args(argv)
     cmd = [c for c in args.cmd if c != "--"]
     if not cmd:
         cmd = ["-m", "distributed_training_tpu.train"]
-    procs = launch_local(cmd, args.nproc, args.devices_per_proc,
-                         log_dir=args.log_dir)
-    rc = wait(procs)
+    if args.supervise:
+        rc = _supervised_main(args, cmd)
+    else:
+        procs = launch_local(cmd, args.nproc, args.devices_per_proc,
+                             log_dir=args.log_dir)
+        rc = wait(procs)
     if rc == 0 and args.summarize:
         from distributed_training_tpu.telemetry import summarize
         summarize.main([args.summarize])
     return rc
+
+
+def _supervised_main(args, cmd: list[str]) -> int:
+    """``--supervise``: run incarnations of the local process group
+    under the restart supervisor. Supervisor state (exit sentinels,
+    its own event stream) lives under ``<log_dir>/supervisor/``; each
+    incarnation's per-process logs go to ``<log_dir>/attempt_<i>/``."""
+    from distributed_training_tpu.resilience import supervisor as sup
+    from distributed_training_tpu.telemetry import Telemetry
+    state_dir = os.path.join(args.log_dir, "supervisor")
+    tel = Telemetry(
+        events_jsonl=os.path.join(state_dir, "events.jsonl"),
+        fresh=False)
+
+    def run_incarnation(extra_env: dict[str, str]) -> int:
+        attempt = extra_env.get(sup.ENV_RESTART_COUNT, "0")
+        procs = launch_local(
+            cmd, args.nproc, args.devices_per_proc,
+            log_dir=os.path.join(args.log_dir, f"attempt_{attempt}"),
+            env=extra_env)
+        return wait(procs)
+
+    try:
+        result = sup.supervise(
+            run_incarnation,
+            policy=sup.RestartPolicy(
+                max_restarts=args.max_restarts,
+                backoff_base_s=args.backoff_base_s),
+            state_dir=state_dir,
+            ckpt_dir=args.ckpt_dir,
+            telemetry=tel,
+            should_stop=lambda: _launcher_signaled)
+    finally:
+        tel.close()
+    return result.returncode
 
 
 if __name__ == "__main__":

@@ -1,0 +1,31 @@
+"""Resilience: crash-restart-resume made real.
+
+The reference repo's failure model is crash-restart-resume (SURVEY.md
+§5.3: bounded rendezvous retries at bring-up, checkpoint recovery on
+restart), and utils/preemption.py already covers the COOPERATIVE half
+(SIGTERM → clean final save). This package supplies the other half:
+
+- ``supervisor.py`` — a restart supervisor (used by ``launch/local.py
+  --supervise``) that relaunches dead training processes with
+  exponential backoff + jitter, classifies exits (completed /
+  preempted / watchdog-abort / crash, via an exit-status sentinel the
+  training process and the watchdog abort path write), and detects
+  crash-loops by CHECKPOINT PROGRESS: an incarnation that commits a
+  new on-disk step refunds the retry budget, one that doesn't burns
+  it, so a deterministic step-N crash gives up fast.
+- ``integrity.py`` — per-file checksum manifests written at every
+  checkpoint save; restore verifies, quarantines a bad step
+  (``step_<N>.corrupt``) and falls back to the next-older good
+  checkpoint instead of crashing the run.
+- ``faults.py`` — config-driven deterministic fault injection
+  (``train.fault_plan="crash@40,sigterm@80,..."``), every trigger a
+  pure function of the global step (the straggler.py discipline:
+  multi-host injection cannot deadlock), which is what makes the two
+  pillars above testable end-to-end on CPU.
+
+This ``__init__`` is deliberately import-free: the supervisor runs in
+the LAUNCHER parent process and must not drag in orbax or the
+telemetry stack on import (``from distributed_training_tpu.resilience
+import supervisor`` adds nothing beyond what the package root already
+loads). Event schema + failure model: docs/robustness.md.
+"""

@@ -1,0 +1,286 @@
+"""Deterministic fault injection: the test harness for recovery.
+
+``train.fault_plan`` is a comma-separated plan of scheduled faults,
+each a pure function of the global optimizer step — the straggler.py
+discipline: on a multi-host pod every host evaluates the same trigger
+at the same loop point, so an injected fault can never leave hosts on
+different sides of a collective (veScale's deterministic
+single-controller property, preserved under fault injection).
+
+Grammar (docs/robustness.md)::
+
+    plan    := entry ("," entry)*
+    entry   := kind "@" step (":" modifier)*
+    kind    := crash | sigterm | corrupt_ckpt | data_stall | data_error
+    modifier:= "always" | duration          # duration: "500ms" | "2s"
+
+- ``crash@40``        raise ``InjectedCrash`` after step 40 completes
+  (hard failure: no final save; recovery = supervisor restart +
+  checkpoint resume).
+- ``sigterm@80``      deliver SIGTERM to this process at step 80
+  (exercises the PreemptionGuard clean-save path).
+- ``corrupt_ckpt@120`` flip bytes in the newest committed checkpoint
+  once a save at step >= 120 lands (exercises manifest verification,
+  quarantine, and the restore fallback chain).
+- ``data_stall@60:500ms`` sleep 500ms in batch assembly at step 60
+  (exercises data_wait accounting and the hang watchdog).
+- ``data_error@60``   raise a transient ``InjectedDataError`` in batch
+  assembly at step 60 (exercises the loader's bounded retry).
+
+**One-shot vs. always:** a restarted run re-executes the steps since
+the last checkpoint, so a naive step trigger re-fires every
+incarnation and nothing ever recovers. Faults are therefore one-shot
+by default: firing is recorded in a small ledger file BEFORE the
+action, and already-fired faults are skipped after restart (every
+host loads the same ledger state at startup, so the skip is as
+deterministic as the trigger). ``:always`` disables the ledger for
+that fault — the deliberate crash-loop used to test the supervisor's
+budget exhaustion.
+
+Every firing emits a ``fault_injected`` telemetry event.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import re
+import signal
+import time
+from dataclasses import dataclass
+
+logger = logging.getLogger(__name__)
+
+KINDS = ("crash", "sigterm", "corrupt_ckpt", "data_stall", "data_error")
+
+_ENTRY_RE = re.compile(r"^(?P<kind>[a-z_]+)@(?P<step>\d+)"
+                       r"(?P<mods>(?::[A-Za-z0-9.]+)*)$")
+_DURATION_RE = re.compile(r"^(?P<num>\d+(?:\.\d+)?)(?P<unit>ms|s)$")
+
+
+class FaultPlanError(ValueError):
+    """Malformed ``train.fault_plan`` string."""
+
+
+class InjectedCrash(RuntimeError):
+    """A scheduled hard failure (``crash@N``). Propagates out of the
+    step loop uncaught — the process dies without a final save, which
+    is the point."""
+
+
+class InjectedDataError(OSError):
+    """A scheduled TRANSIENT input-pipeline failure (``data_error@N``).
+    Subclasses OSError so the loader's retry path treats it exactly
+    like a real IO blip."""
+
+
+def parse_duration_s(text: str) -> float:
+    m = _DURATION_RE.match(text)
+    if not m:
+        raise FaultPlanError(
+            f"bad duration {text!r} (want e.g. '500ms' or '2s')")
+    v = float(m.group("num"))
+    return v / 1000.0 if m.group("unit") == "ms" else v
+
+
+@dataclass(frozen=True)
+class Fault:
+    kind: str
+    step: int
+    always: bool = False
+    stall_s: float = 0.0
+
+    @property
+    def key(self) -> str:
+        """Ledger identity. Deliberately excludes modifiers: the plan
+        is config, the (kind, step) pair is the scheduled incident."""
+        return f"{self.kind}@{self.step}"
+
+
+def parse_fault_plan(spec: str) -> tuple[Fault, ...]:
+    """Parse ``"crash@40,sigterm@80,data_stall@60:500ms"`` → faults."""
+    faults: list[Fault] = []
+    seen: set[str] = set()
+    for raw in spec.split(","):
+        entry = raw.strip()
+        if not entry:
+            continue
+        m = _ENTRY_RE.match(entry)
+        if not m:
+            raise FaultPlanError(
+                f"bad fault entry {entry!r} (want kind@step[:modifier],"
+                f" kinds: {', '.join(KINDS)})")
+        kind = m.group("kind")
+        if kind not in KINDS:
+            raise FaultPlanError(
+                f"unknown fault kind {kind!r} in {entry!r} "
+                f"(kinds: {', '.join(KINDS)})")
+        step = int(m.group("step"))
+        if step <= 0:
+            raise FaultPlanError(
+                f"fault step must be >= 1 in {entry!r}")
+        always = False
+        stall_s = 0.0
+        mods = [t for t in m.group("mods").split(":") if t]
+        for tok in mods:
+            if tok == "always":
+                always = True
+            else:
+                stall_s = parse_duration_s(tok)
+        if stall_s and kind != "data_stall":
+            raise FaultPlanError(
+                f"duration modifier only applies to data_stall, "
+                f"got {entry!r}")
+        if kind == "data_stall" and not stall_s:
+            raise FaultPlanError(
+                f"data_stall needs a duration, e.g. "
+                f"'data_stall@{step}:500ms' (got {entry!r})")
+        f = Fault(kind=kind, step=step, always=always, stall_s=stall_s)
+        if f.key in seen:
+            raise FaultPlanError(f"duplicate fault {f.key!r}")
+        seen.add(f.key)
+        faults.append(f)
+    return tuple(faults)
+
+
+def corrupt_step_dir(step_dir: str, nbytes: int = 64) -> str | None:
+    """Deterministically damage the largest file in a committed step
+    dir (invert ``nbytes`` in the middle), leaving the manifest alone
+    so verification CATCHES the damage. Returns the damaged path."""
+    from distributed_training_tpu.resilience import integrity
+    files = [(os.path.getsize(p), rel, p)
+             for rel, p in integrity._iter_files(step_dir)]
+    files = [f for f in files if f[0] > 0]
+    if not files:
+        return None
+    size, _rel, path = max(files)
+    with open(path, "r+b") as f:
+        off = max(0, size // 2 - nbytes // 2)
+        f.seek(off)
+        chunk = f.read(min(nbytes, size - off))
+        f.seek(off)
+        f.write(bytes(b ^ 0xFF for b in chunk))
+    return path
+
+
+class FaultInjector:
+    """Evaluates the plan at the three hook points (trainer step loop,
+    data loader, checkpoint manager) and performs due faults.
+
+    ``ledger_path`` holds the fired-set across restarts (one file per
+    host — each host fires deterministically and records its own).
+    ``ckpt_dir`` is where ``corrupt_ckpt`` finds its victim."""
+
+    def __init__(self, plan: tuple[Fault, ...] | str,
+                 ledger_path: str | None = None,
+                 ckpt_dir: str | None = None):
+        self.plan = (parse_fault_plan(plan) if isinstance(plan, str)
+                     else tuple(plan))
+        self.ledger_path = ledger_path
+        self.ckpt_dir = ckpt_dir
+        self.fired: set[str] = set()
+        if ledger_path and os.path.exists(ledger_path):
+            try:
+                with open(ledger_path) as f:
+                    self.fired = set(json.load(f).get("fired", []))
+            except (OSError, ValueError) as e:
+                logger.warning("unreadable fault ledger %s (%s); "
+                               "treating all faults as unfired",
+                               ledger_path, e)
+        if self.plan:
+            logger.info(
+                "fault plan armed: %s (already fired: %s)",
+                ", ".join(f.key + (":always" if f.always else "")
+                          for f in self.plan),
+                sorted(self.fired) or "none")
+
+    # -- internals ---------------------------------------------------------
+
+    def _due(self, step: int, kinds: tuple[str, ...]) -> list[Fault]:
+        return [f for f in self.plan
+                if f.kind in kinds and f.step == step
+                and (f.always or f.key not in self.fired)]
+
+    def _record(self, fault: Fault, **info) -> None:
+        """Mark fired — ledger write BEFORE the action, so a fault
+        that kills the process cannot re-fire after restart."""
+        self.fired.add(fault.key)
+        if self.ledger_path:
+            os.makedirs(os.path.dirname(self.ledger_path) or ".",
+                        exist_ok=True)
+            tmp = f"{self.ledger_path}.tmp.{os.getpid()}"
+            with open(tmp, "w") as f:
+                json.dump({"fired": sorted(self.fired)}, f)
+            os.replace(tmp, self.ledger_path)
+        from distributed_training_tpu import telemetry
+        # "fault_kind", not "kind": the sink uses "kind" as the record
+        # type, and a kwarg would silently overwrite it.
+        telemetry.event("fault_injected", fault=fault.key,
+                        fault_kind=fault.kind, step=fault.step,
+                        always=fault.always, **info)
+        logger.warning("FAULT INJECTED: %s %s", fault.key, info or "")
+
+    # -- hook points -------------------------------------------------------
+
+    def on_step(self, global_step: int) -> None:
+        """Trainer step loop, after step ``global_step``'s bookkeeping.
+        Graceful faults fire before lethal ones so a plan scheduling
+        both at one step still exercises the graceful path."""
+        for f in self._due(global_step, ("sigterm",)):
+            self._record(f)
+            signal.raise_signal(signal.SIGTERM)
+        for f in self._due(global_step, ("crash",)):
+            self._record(f)
+            raise InjectedCrash(
+                f"injected crash at global step {global_step}")
+
+    def on_data(self, step: int) -> None:
+        """Data path, once per batch assembly ATTEMPT (inside the
+        loader's retry loop, so a transient injected error is retried
+        exactly like a real one). ``step`` is the loader's
+        deterministic batch counter."""
+        for f in self._due(step, ("data_stall",)):
+            self._record(f, stall_s=f.stall_s)
+            time.sleep(f.stall_s)
+        for f in self._due(step, ("data_error",)):
+            self._record(f)
+            raise InjectedDataError(
+                f"injected transient data error at step {step}")
+
+    def on_checkpoint_saved(self, step: int,
+                            directory: str | None = None) -> None:
+        """Checkpoint manager, after a save at ``step`` is committed.
+        A ``corrupt_ckpt@N`` fires at the first save with step >= N
+        (saves land on a cadence; an exact-match step would usually
+        never fire). Called on the COORDINATOR only (the manager
+        gates it): on shared storage N hosts XOR-flipping the same
+        bytes would undo each other.
+
+        Only steps that already have a checksum manifest are eligible
+        victims: corrupting a not-yet-manifested step would let the
+        later manifest flush checksum the damaged bytes and BLESS the
+        corruption — the injected fault must be the one verification
+        catches, never one it hides. With async saves the newest step
+        is still unmanifested when this hook runs, so the previous
+        step takes the damage; the fault stays pending until a
+        manifested step exists."""
+        directory = directory or self.ckpt_dir
+        if directory is None:
+            return
+        from distributed_training_tpu.resilience import integrity
+        for f in self.plan:
+            if (f.kind != "corrupt_ckpt" or step < f.step
+                    or (not f.always and f.key in self.fired)):
+                continue
+            target = next(
+                (s for s in reversed(
+                    integrity.checkpoint_steps_on_disk(directory))
+                 if os.path.exists(os.path.join(
+                     directory, str(s), integrity.MANIFEST_NAME))),
+                None)
+            if target is None:
+                continue
+            step_dir = os.path.join(directory, str(target))
+            damaged = corrupt_step_dir(step_dir)
+            self._record(f, target_step=target, damaged=damaged)

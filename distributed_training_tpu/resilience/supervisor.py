@@ -1,0 +1,336 @@
+"""Restart supervisor: relaunch dead training processes, bounded.
+
+The missing half of the reference's crash-restart-resume failure model
+(SURVEY.md §5.3): ``utils/preemption.py`` makes SIGTERM graceful and
+the checkpoint layer makes restarts resumable, but nothing RESTARTED a
+crashed process. ``launch/local.py --supervise`` drives this loop; the
+same ``supervise()`` is the template a pod-level agent (one supervisor
+per host VM) would run.
+
+Three design points, per the issue spec:
+
+- **Exit classification** — a supervised training process writes an
+  exit-status sentinel (``write_exit_status``: "completed" /
+  "preempted"; the hang-watchdog abort path writes
+  "watchdog_abort" before its ``os._exit(42)``). The supervisor reads
+  the sentinels and falls back to return-code heuristics (SIGTERM
+  death = preemption) when a crash died too hard to write one.
+- **Progress-refunded retry budget** — an incarnation that COMMITS A
+  NEW checkpoint step refunds the budget to ``max_restarts``; one
+  that doesn't burns one. (A new step, not a higher number than ever
+  seen: a restore-time quarantine lowers the latest on-disk step
+  while the run still advances from its usable base.) A
+  deterministic step-N crash (same fault every incarnation, no new
+  checkpoint) therefore exhausts the budget in ``max_restarts + 1``
+  incarnations instead of looping forever, while a long healthy run
+  survives any number of DISTINCT failures.
+- **Exponential backoff + jitter** — per consecutive non-advancing
+  failure, capped; deterministic given the seed (reproducible tests),
+  jittered so a pod of supervisors doesn't reconnect in lockstep.
+
+This module must stay importable in the launcher parent without
+orbax/telemetry (progress scanning is the orbax-free
+``integrity.checkpoint_steps_on_disk``); the telemetry sink is an
+optional injected parameter.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import logging
+import os
+import random
+import time
+from dataclasses import dataclass, field
+from typing import Callable
+
+from distributed_training_tpu.resilience.integrity import (
+    checkpoint_steps_on_disk)
+
+logger = logging.getLogger(__name__)
+
+# Exit outcomes, worst-first. Sentinel files carry these in "outcome".
+COMPLETED = "completed"
+PREEMPTED = "preempted"
+WATCHDOG_ABORT = "watchdog_abort"
+CRASH = "crash"
+
+# Keep in sync with telemetry/watchdog.py::HangWatchdog.EXIT_CODE —
+# not imported, to keep this module telemetry-free in the parent.
+WATCHDOG_EXIT_CODE = 42
+
+ENV_SENTINEL = "DTT_EXIT_SENTINEL"
+ENV_RESTART_COUNT = "DTT_RESTART_COUNT"
+
+
+# ---------------------------------------------------------------------------
+# exit-status sentinels (written by the CHILD, read by the supervisor)
+# ---------------------------------------------------------------------------
+
+
+def sentinel_path() -> str | None:
+    """This process's own sentinel file, or None when unsupervised.
+
+    The supervisor exports one base path per incarnation; each process
+    of a (possibly multi-process) incarnation appends its pid so local
+    pod simulations don't clobber each other's verdicts."""
+    base = os.environ.get(ENV_SENTINEL)
+    if not base:
+        return None
+    return f"{base}.pid{os.getpid()}.json"
+
+
+def write_exit_status(outcome: str, **fields) -> str | None:
+    """Record how this process is about to exit (atomic; no-op when
+    unsupervised). Called by the train CLI on clean exits and by the
+    watchdog abort path right before ``os._exit``."""
+    path = sentinel_path()
+    if path is None:
+        return None
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    tmp = f"{path}.tmp"
+    with open(tmp, "w") as f:
+        json.dump({"outcome": outcome, "pid": os.getpid(),
+                   "t": time.time(), **fields}, f)
+    os.replace(tmp, path)
+    return path
+
+
+def read_exit_statuses(base: str) -> list[dict]:
+    """All sentinels an incarnation's processes left behind."""
+    out = []
+    for path in sorted(glob.glob(f"{base}.pid*.json")):
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if isinstance(rec, dict):
+            out.append(rec)
+    return out
+
+
+def classify_exit(returncode: int, statuses: list[dict]) -> str:
+    """One outcome for the whole incarnation, worst report wins.
+
+    Sentinels are authoritative when present (a preempted process
+    exits 0 — only the sentinel distinguishes it from completion);
+    return codes cover processes that died too hard to write one
+    (SIGKILL, segfault, ``os._exit``)."""
+    outcomes = {s.get("outcome") for s in statuses}
+    if WATCHDOG_ABORT in outcomes or returncode == WATCHDOG_EXIT_CODE:
+        return WATCHDOG_ABORT
+    if returncode == 0:
+        return PREEMPTED if PREEMPTED in outcomes else COMPLETED
+    # 143/130: death by SIGTERM/SIGINT (launch.wait encodes signal
+    # deaths as 128 + signum) — the external-preemption shape. Any
+    # OTHER nonzero rc is a crash even when one process of the group
+    # wrote a preempted sentinel: worst report wins, and a crash must
+    # burn retry budget — a preemption verdict would refund it.
+    if returncode in (143, 130):
+        return PREEMPTED
+    return CRASH
+
+
+# ---------------------------------------------------------------------------
+# restart policy
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class RestartPolicy:
+    """Budget + backoff knobs (CLI: ``--max-restarts``,
+    ``--backoff-base-s``)."""
+
+    max_restarts: int = 3
+    backoff_base_s: float = 1.0
+    backoff_factor: float = 2.0
+    backoff_max_s: float = 60.0
+    jitter: float = 0.2          # +/- fraction of the backoff
+    seed: int = 0                # jitter stream (deterministic tests)
+
+    def backoff_s(self, consecutive_failures: int) -> float:
+        """Delay before the next restart after ``consecutive_failures``
+        (>=1) non-advancing failures in a row. Exponential, capped,
+        with deterministic +/-jitter."""
+        n = max(1, consecutive_failures)
+        base = min(self.backoff_max_s,
+                   self.backoff_base_s * self.backoff_factor ** (n - 1))
+        # Int seed only: tuple seeding raises TypeError on 3.11+.
+        rng = random.Random(self.seed * 1_000_003 + n)
+        return base * (1.0 + self.jitter * rng.uniform(-1.0, 1.0))
+
+
+@dataclass
+class Incident:
+    """One supervised incarnation's outcome (the give-up summary)."""
+
+    incarnation: int
+    returncode: int
+    outcome: str
+    wall_s: float
+    ckpt_step: int | None
+    advanced: bool
+    budget_after: int = 0
+    backoff_s: float = 0.0
+
+
+@dataclass
+class SuperviseResult:
+    returncode: int
+    incidents: list[Incident] = field(default_factory=list)
+
+    @property
+    def restarts(self) -> int:
+        return max(0, len(self.incidents) - 1)
+
+    def summary_lines(self) -> list[str]:
+        lines = [f"supervisor: {len(self.incidents)} incarnation(s), "
+                 f"{self.restarts} restart(s), final rc "
+                 f"{self.returncode}"]
+        for inc in self.incidents:
+            lines.append(
+                f"  #{inc.incarnation}: {inc.outcome} rc={inc.returncode}"
+                f" wall={inc.wall_s:.1f}s ckpt_step={inc.ckpt_step}"
+                f"{' (advanced)' if inc.advanced else ''}"
+                f" budget={inc.budget_after}")
+        return lines
+
+
+# ---------------------------------------------------------------------------
+# the loop
+# ---------------------------------------------------------------------------
+
+
+def supervise(run_incarnation: Callable[[dict[str, str]], int],
+              *,
+              policy: RestartPolicy | None = None,
+              state_dir: str,
+              ckpt_dir: str | None = None,
+              telemetry=None,
+              sleep: Callable[[float], None] = time.sleep,
+              should_stop: Callable[[], bool] | None = None,
+              ) -> SuperviseResult:
+    """Run ``run_incarnation(extra_env)`` until completion or budget
+    exhaustion; returns the final rc plus the incident log.
+
+    ``run_incarnation`` launches ONE incarnation of the training job
+    (all its processes) with the given extra environment merged in,
+    blocks, and returns the group's exit code — for the local
+    launcher that is ``launch_local(...)`` + ``wait(...)``.
+
+    ``ckpt_dir`` enables progress-based budget refunds; without it
+    every non-completed exit burns budget (strictly bounded either
+    way). ``telemetry`` (an events.Telemetry or None) records one
+    ``restart`` event per relaunch and a ``supervisor_give_up`` event
+    on budget exhaustion. ``should_stop`` (checked between
+    incarnations) lets the caller end supervision from the outside —
+    the launcher's own preemption path."""
+    policy = policy or RestartPolicy()
+    os.makedirs(state_dir, exist_ok=True)
+    result = SuperviseResult(returncode=0)
+    budget = policy.max_restarts
+    streak = 0  # consecutive failures without checkpoint progress
+    incarnation = 0
+    while True:
+        base = os.path.join(state_dir, f"exit_{incarnation}")
+        # A previous supervisor run in the same state_dir (log dirs
+        # default to a constant path) left sentinels at these indices;
+        # pids differ so the glob would mix its verdicts into THIS
+        # incarnation's classification — e.g. a stale watchdog_abort
+        # burning budget on a run that just completed.
+        for stale in glob.glob(f"{base}.pid*.json"):
+            try:
+                os.remove(stale)
+            except OSError:
+                pass
+        env = {ENV_SENTINEL: base,
+               ENV_RESTART_COUNT: str(incarnation)}
+        pre_steps = (set(checkpoint_steps_on_disk(ckpt_dir))
+                     if ckpt_dir else set())
+        t0 = time.monotonic()
+        rc = run_incarnation(env)
+        wall = time.monotonic() - t0
+        outcome = classify_exit(rc, read_exit_statuses(base))
+        post_steps = (set(checkpoint_steps_on_disk(ckpt_dir))
+                      if ckpt_dir else set())
+        step = max(post_steps) if post_steps else None
+        # Progress = a NEW committed checkpoint this incarnation, not
+        # a higher number than ever seen: a restore-time quarantine
+        # LOWERS the latest on-disk step while the incarnation still
+        # genuinely advances from its usable base — comparing against
+        # an all-time high-water mark would burn budget on a
+        # recovering run until it re-passed the condemned step.
+        advanced = bool(post_steps - pre_steps)
+        incident = Incident(incarnation=incarnation, returncode=rc,
+                            outcome=outcome, wall_s=wall,
+                            ckpt_step=step, advanced=advanced)
+        result.incidents.append(incident)
+        if outcome == COMPLETED:
+            incident.budget_after = budget
+            result.returncode = 0
+            for line in result.summary_lines():
+                logger.info("%s", line)
+            return result
+        if should_stop is not None and should_stop():
+            # The SUPERVISOR was told to stop (e.g. the launcher was
+            # preempted and forwarded the signal): the children saved
+            # and exited — releasing the machine beats restarting the
+            # job the infrastructure just reclaimed.
+            incident.budget_after = budget
+            result.returncode = rc
+            logger.warning("supervisor: stop requested; not "
+                           "restarting (last outcome %s rc=%d)",
+                           outcome, rc)
+            return result
+        # Budget: checkpoint progress (or a clean preemption, which is
+        # the infrastructure's fault, not the job's) refunds; anything
+        # else burns. This is what turns a deterministic step-N crash
+        # into a fast, bounded give-up (see module docstring).
+        if advanced:
+            budget = policy.max_restarts
+            streak = 0
+        elif outcome == PREEMPTED:
+            # Refund the budget (not the job's fault) but KEEP the
+            # backoff escalating: a preemption storm with zero
+            # checkpoint progress must wait out the capped backoff
+            # between attempts, never hot-loop restarts.
+            budget = policy.max_restarts
+            streak += 1
+        else:
+            budget -= 1
+            streak += 1
+        incident.budget_after = budget
+        if budget < 0:
+            result.returncode = rc if rc != 0 else 1
+            logger.error(
+                "supervisor: giving up after %d incarnation(s) — no "
+                "checkpoint progress in the last %d attempt(s) "
+                "(crash-loop); last outcome %s rc=%d",
+                len(result.incidents), streak, outcome, rc)
+            for line in result.summary_lines():
+                logger.error("%s", line)
+            if telemetry is not None:
+                telemetry.event("supervisor_give_up",
+                                incarnations=len(result.incidents),
+                                streak=streak, outcome=outcome,
+                                returncode=rc)
+            return result
+        delay = policy.backoff_s(streak) if streak else 0.0
+        incident.backoff_s = delay
+        logger.warning(
+            "supervisor: incarnation %d exited %s (rc=%d) after %.1fs; "
+            "ckpt_step=%s%s; restarting in %.2fs "
+            "(budget %d/%d)",
+            incarnation, outcome, rc, wall, step,
+            " (advanced)" if advanced else "", delay, budget,
+            policy.max_restarts)
+        if telemetry is not None:
+            telemetry.event("restart", incarnation=incarnation,
+                            outcome=outcome, returncode=rc,
+                            ckpt_step=step, advanced=advanced,
+                            backoff_s=round(delay, 3), budget=budget)
+        if delay > 0:
+            sleep(delay)
+        incarnation += 1

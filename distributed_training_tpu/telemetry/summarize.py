@@ -109,6 +109,70 @@ def _hbm(events: list[dict]) -> dict | None:
     return out
 
 
+def _recovery(events: list[dict]) -> dict | None:
+    """Recovery table (docs/robustness.md): every restart appends a
+    new ``run_start`` marker to the same stream, so incidents are the
+    segment boundaries — time-to-recover is the gap between a
+    segment's last record and the next ``run_start``, and steps lost
+    is the crashed segment's high-water step minus the step the next
+    incarnation resumed from. Quarantines, injected faults, and data
+    retries ride along. None when the run had nothing to recover
+    from (the common case — the section stays out of the report)."""
+    segments: list[dict] = []
+    for e in events:
+        t = e.get("t")
+        if e.get("kind") == "run_start" or not segments:
+            segments.append({"t_start": t, "t_last": t,
+                             "start_step": e.get("step"),
+                             "max_step": None, "resume": None})
+        seg = segments[-1]
+        if isinstance(t, (int, float)):
+            seg["t_last"] = max(seg["t_last"] or t, t)
+        if e.get("kind") == "resume" and seg["resume"] is None:
+            seg["resume"] = e
+        step = e.get("step")
+        if isinstance(step, int):
+            seg["max_step"] = max(seg["max_step"] or 0, step)
+    incidents = []
+    for prev, cur in zip(segments, segments[1:]):
+        if cur["resume"] is None:
+            # A later session appended to the stream without resuming
+            # training (e.g. an offline eval, PR2 semantics) is not a
+            # recovery incident.
+            continue
+        resume_step = cur["resume"].get("step", cur["start_step"])
+        lost = None
+        if (isinstance(prev["max_step"], int)
+                and isinstance(resume_step, int)):
+            lost = max(0, prev["max_step"] - resume_step)
+        gap = None
+        if (isinstance(prev["t_last"], (int, float))
+                and isinstance(cur["t_start"], (int, float))):
+            gap = round(max(0.0, cur["t_start"] - prev["t_last"]), 3)
+        incidents.append({
+            "resumed_at_step": resume_step,
+            "prev_max_step": prev["max_step"],
+            "steps_lost": lost,
+            "time_to_recover_s": gap,
+            "restarts": (cur["resume"] or {}).get("restarts"),
+        })
+    quarantined = [e for e in events
+                   if e.get("kind") == "ckpt_quarantined"]
+    faults = [e for e in events if e.get("kind") == "fault_injected"]
+    retries = [e for e in events if e.get("kind") == "data_retry"]
+    if not incidents and not quarantined and not faults \
+            and not retries:
+        return None
+    return {
+        "restarts": len(incidents),
+        "incidents": incidents,
+        "quarantined": [{"step": e.get("step"), "path": e.get("path")}
+                        for e in quarantined],
+        "faults_injected": [e.get("fault") for e in faults],
+        "data_retries": len(retries),
+    }
+
+
 def _spans(events: list[dict]) -> dict:
     agg: dict[str, dict] = {}
     for e in events:
@@ -140,6 +204,7 @@ def summarize_run(run_dir: str) -> dict:
         "goodput": _goodput(events),
         "hbm": _hbm(events),
         "collectives": _collectives(events),
+        "recovery": _recovery(events),
         "spans": _spans(events),
         "watchdog_firings": [e for e in events
                              if e.get("kind") == "watchdog_fired"],
@@ -211,6 +276,29 @@ def render(summary: dict) -> str:
             a = spans[name]
             lines.append(f"  {name:14s} {a['count']:5d}  "
                          f"{a['total_s']:9.3f}s  {a['max_s']:8.3f}s")
+    rec = summary.get("recovery")
+    if rec:
+        lines.append(
+            f"recovery: {rec['restarts']} restart(s), "
+            f"{len(rec['quarantined'])} checkpoint(s) quarantined, "
+            f"{rec['data_retries']} data retr"
+            f"{'y' if rec['data_retries'] == 1 else 'ies'}")
+        for i, inc in enumerate(rec["incidents"]):
+            ttr = inc.get("time_to_recover_s")
+            lost = inc.get("steps_lost")
+            lines.append(
+                f"  incident {i}: resumed at step "
+                f"{inc.get('resumed_at_step')}"
+                + (f" ({lost} step(s) lost)" if lost is not None
+                   else "")
+                + (f", recovered in {ttr:.1f}s" if ttr is not None
+                   else ""))
+        for q in rec["quarantined"]:
+            lines.append(f"  QUARANTINED step {q.get('step')}: "
+                         f"{q.get('path')}")
+        if rec["faults_injected"]:
+            lines.append("  faults injected: "
+                         + ", ".join(map(str, rec["faults_injected"])))
     for w in summary.get("watchdog_firings", []):
         lines.append(f"WATCHDOG FIRED: {w.get('postmortem')}")
     for p in summary.get("postmortems", []):

@@ -27,10 +27,13 @@ import atexit
 import faulthandler
 import itertools
 import json
+import logging
 import os
 import sys
 import threading
 import time
+
+logger = logging.getLogger(__name__)
 
 # Monotonic per-process suffix: two postmortems in the same second
 # (e.g. a watchdog firing while a budget timer also fires) must land
@@ -104,8 +107,10 @@ def write_postmortem(base_dir: str, reason: str,
                              name="postmortem-memory-stats")
         t.start()
         t.join(timeout=10)
-    except Exception:  # noqa: BLE001
-        pass
+    except Exception as e:  # noqa: BLE001 — never raises (docstring);
+        # best-effort breadcrumb only (DTT002: no silent swallows).
+        logger.debug("postmortem bundle incomplete at %s: %s: %s",
+                     path, type(e).__name__, e)
     return path
 
 
@@ -206,6 +211,19 @@ class HangWatchdog:
                                  postmortem=self.fired_path,
                                  timeout_s=timeout_s, **info)
         if self.abort:
+            # Exit-status sentinel FIRST: the restart supervisor
+            # classifies this death as watchdog_abort (vs crash) by
+            # reading it — rc 42 alone also classifies, but the
+            # sentinel carries the postmortem path into the incident
+            # log. Best-effort: the abort must fire regardless.
+            try:
+                from distributed_training_tpu.resilience.supervisor \
+                    import WATCHDOG_ABORT, write_exit_status
+                write_exit_status(WATCHDOG_ABORT,
+                                  postmortem=self.fired_path)
+            except Exception as e:  # noqa: BLE001
+                logger.debug("watchdog abort sentinel not written: "
+                             "%s: %s", type(e).__name__, e)
             # The stacks are on disk; a process wedged in a C call
             # cannot run atexit handlers anyway.
             os._exit(self.EXIT_CODE)

@@ -74,6 +74,18 @@ def main(argv: list[str] | None = None) -> int:
     from distributed_training_tpu.models import build_model
     from distributed_training_tpu.train.trainer import Trainer
 
+    # Deterministic fault injection (resilience/faults.py): hooks in
+    # the step loop, the data loader, and the checkpoint manager; the
+    # per-host ledger makes faults one-shot across supervisor
+    # restarts. Empty plan → no injector, zero overhead.
+    fault_injector = None
+    if cfg.train.fault_plan:
+        from distributed_training_tpu.resilience import faults
+        fault_injector = faults.FaultInjector(
+            faults.parse_fault_plan(cfg.train.fault_plan),
+            ledger_path=os.path.join(host_dir, "faults_fired.json"),
+            ckpt_dir=cfg.train.snapshot_path)
+
     dataset = build_dataset(
         cfg.train.dataset,
         _defaults={"size": cfg.train.dataset_size,
@@ -98,58 +110,94 @@ def main(argv: list[str] | None = None) -> int:
         seed=cfg.train.seed,
         drop_last=cfg.train.drop_last,
         max_steps_per_epoch=cfg.train.max_steps_per_epoch,
+        data_retries=cfg.train.data_retries,
+        fault_injector=fault_injector,
     )
     model_kwargs = dict(cfg.model.kwargs)
     # model-level dtype override wins over the training compute dtype
     model_dtype = model_kwargs.pop("dtype", cfg.train.dtype)
     model = build_model(cfg.model.name, loss=cfg.train.loss,
                         dtype=model_dtype, **model_kwargs)
-    checkpointer = Checkpointer(cfg.train.snapshot_path)
 
+    from distributed_training_tpu.resilience import supervisor as sup
     from distributed_training_tpu.utils.preemption import PreemptionGuard
     guard = PreemptionGuard.install()
 
-    # Telemetry: an event stream on EVERY process (multi-host runs
-    # write per-host streams the aggregator merges; docs/
-    # observability.md), hang watchdog on every process too (hangs are
-    # host-specific; each host writes its own postmortem bundle).
-    resumed = checkpointer.latest_step() is not None
-    tel = telemetry_lib.install(telemetry_lib.Telemetry(
-        events_jsonl=cfg.train.events_jsonl,
-        enabled=True,
-        fresh=not resumed,
-        start_step=checkpointer.latest_step() or 0,
-        host_id=(rt.process_index if rt.process_count > 1 else None)))
-    # Clock-sync record: the runtime captured one barrier-anchored
-    # timestamp per host at setup; emitting it into each stream is
-    # what lets the offline aggregator put N host clocks on one axis.
-    tel.event("clock_sync", **rt.clock_sync_record())
-    watchdog = None
-    if cfg.train.watchdog_timeout_s > 0:
-        watchdog = telemetry_lib.HangWatchdog(
-            cfg.train.watchdog_timeout_s,
-            os.path.join(host_dir, "postmortem"),
-            telemetry=tel, abort=cfg.train.watchdog_abort)
+    # Context-managed checkpointer: __exit__ runs wait() + close() on
+    # EVERY exit path — preemption, watchdog stop, fault-injected
+    # crash — so an in-flight async save is never dropped.
+    with Checkpointer(cfg.train.snapshot_path,
+                      fault_injector=fault_injector) as checkpointer:
+        # Telemetry: an event stream on EVERY process (multi-host runs
+        # write per-host streams the aggregator merges; docs/
+        # observability.md), hang watchdog on every process too (hangs
+        # are host-specific; each host writes its own postmortem
+        # bundle).
+        resumed = checkpointer.latest_step() is not None
+        restart_count = int(os.environ.get(
+            sup.ENV_RESTART_COUNT, "0") or 0)
+        # fresh only on a genuinely first incarnation: a supervised
+        # restart that found NO checkpoint (crash before the first
+        # save) must APPEND — truncating would destroy the crashed
+        # segment's events and the recovery table's evidence.
+        tel = telemetry_lib.install(telemetry_lib.Telemetry(
+            events_jsonl=cfg.train.events_jsonl,
+            enabled=True,
+            fresh=not (resumed or restart_count > 0),
+            start_step=checkpointer.latest_step() or 0,
+            host_id=(rt.process_index if rt.process_count > 1
+                     else None)))
+        # Clock-sync record: the runtime captured one barrier-anchored
+        # timestamp per host at setup; emitting it into each stream is
+        # what lets the offline aggregator put N host clocks on one
+        # axis.
+        tel.event("clock_sync", **rt.clock_sync_record())
+        watchdog = None
+        if cfg.train.watchdog_timeout_s > 0:
+            watchdog = telemetry_lib.HangWatchdog(
+                cfg.train.watchdog_timeout_s,
+                os.path.join(host_dir, "postmortem"),
+                telemetry=tel, abort=cfg.train.watchdog_abort)
 
-    trainer = Trainer(cfg, rt, model, loader, checkpointer,
-                      preemption_guard=guard, eval_loader=eval_loader,
-                      watchdog=watchdog)
-    try:
-        if cfg.train.profile_dir:
-            from distributed_training_tpu.utils import profiler
-            with profiler.trace(cfg.train.profile_dir,
-                                host_only_on_coordinator=True,
-                                process_index=rt.process_index):
+        trainer = Trainer(cfg, rt, model, loader, checkpointer,
+                          preemption_guard=guard,
+                          eval_loader=eval_loader,
+                          watchdog=watchdog,
+                          fault_injector=fault_injector)
+        if (trainer.epochs_run > 0 or trainer.global_step > 0
+                or restart_count > 0):
+            # Recovery evidence: which step this incarnation picked up
+            # from, and which supervisor incarnation it is (the
+            # summarizer's recovery table joins these with run_start
+            # markers to compute steps-lost and time-to-recover).
+            # Emitted even on a fresh start when this IS a restart
+            # incarnation (crash before the first checkpoint) — the
+            # recovery table must not undercount those.
+            tel.event("resume", step=trainer.global_step,
+                      epoch=trainer.epochs_run,
+                      restarts=restart_count)
+        try:
+            if cfg.train.profile_dir:
+                from distributed_training_tpu.utils import profiler
+                with profiler.trace(cfg.train.profile_dir,
+                                    host_only_on_coordinator=True,
+                                    process_index=rt.process_index):
+                    summary = trainer.train()
+            else:
                 summary = trainer.train()
-        else:
-            summary = trainer.train()
-    finally:
-        if watchdog is not None:
-            watchdog.stop()
-        tel.close()
+        finally:
+            if watchdog is not None:
+                watchdog.stop()
+            tel.close()
     if rt.is_coordinator:
         logger.info("training done: %s", summary)
-    checkpointer.close()
+    # Exit-status sentinel for the restart supervisor: a preempted run
+    # exits 0 after its final save just like a completed one — only
+    # this record tells the supervisor to relaunch vs. stand down.
+    # No-op when unsupervised (no DTT_EXIT_SENTINEL in env).
+    sup.write_exit_status(
+        sup.PREEMPTED if guard.should_stop else sup.COMPLETED,
+        step=trainer.global_step, epochs_run=trainer.epochs_run)
     return 0
 
 

@@ -158,7 +158,7 @@ class Trainer:
     def __init__(self, cfg: Config, runtime: Runtime, model,
                  loader, checkpointer=None, preemption_guard=None,
                  eval_loader=None, abstract: bool = False,
-                 watchdog=None):
+                 watchdog=None, fault_injector=None):
         self.cfg = cfg
         self.rt = runtime
         self.model = model
@@ -178,6 +178,12 @@ class Trainer:
         # step in _run_epoch; owned by the caller (cli builds it from
         # train.watchdog_timeout_s and stops it after train()).
         self.watchdog = watchdog
+        # Deterministic fault injection (resilience/faults.py): the
+        # step-loop hook fires crash/sigterm faults as a pure function
+        # of global_step — the same every-host-same-loop-point
+        # discipline as the straggler exchange, so injection can never
+        # strand hosts on different sides of a collective. None → off.
+        self.faults = fault_injector
         self.ledger = None
         self.hbm = None
         self._steps_dispatched = 0
@@ -641,6 +647,11 @@ class Trainer:
             if self.watchdog is not None:
                 self.watchdog.disarm()
             losses.append(metrics["loss"])
+            if self.faults is not None:
+                # After the step's bookkeeping, before the stop poll:
+                # a sigterm fault raised here is observed by
+                # _agreed_stop at the same loop point on every host.
+                self.faults.on_step(self.global_step)
             if self._agreed_stop():
                 break
         # One host sync per epoch, not per step.

@@ -73,3 +73,39 @@ def test_lint_local_jsonl_rule_scoping(tmp_path):
                         "telemetry", "events.py")
     assert not [p for p in lint_local.check_file(sink)
                 if "DTT001" in p]
+
+
+def test_lint_local_silent_swallow_rule(tmp_path):
+    """DTT002: broad `except ...: pass` fails; narrow handlers,
+    handlers that do something, and justified noqa'd swallows pass."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import lint_local
+    finally:
+        sys.path.pop(0)
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "try:\n    x = 1\nexcept Exception:\n    pass\n"
+        "try:\n    x = 2\nexcept:\n    pass\n"
+        "try:\n    x = 3\nexcept (ValueError, BaseException):\n"
+        "    pass\n")
+    hits = [p for p in lint_local.check_file(str(bad))
+            if "DTT002" in p]
+    assert len(hits) == 3, hits
+    ok = tmp_path / "ok.py"
+    ok.write_text(
+        "import logging\n"
+        "try:\n    x = 1\nexcept FileNotFoundError:\n    pass\n"
+        "try:\n    x = 2\nexcept Exception as e:\n"
+        "    logging.debug('%s', e)\n"
+        "try:\n    x = 3\nexcept Exception:  # noqa: DTT002\n"
+        "    pass\n")
+    assert not [p for p in lint_local.check_file(str(ok))
+                if "DTT002" in p]
+    # A noqa for a DIFFERENT code must not disable this rule.
+    other = tmp_path / "other.py"
+    other.write_text(
+        "try:\n    x = 1\nexcept Exception:  # noqa: E501\n"
+        "    pass\n")
+    assert [p for p in lint_local.check_file(str(other))
+            if "DTT002" in p]

@@ -1,0 +1,892 @@
+"""Resilience subsystem: crash-restart-resume end to end.
+
+Unit tests for the three pillars (supervisor budget/backoff/exit
+classification, checkpoint integrity manifests + quarantine +
+fallback chain, deterministic fault-plan parsing and injection), the
+satellite behaviors (launcher signal forwarding, context-managed
+checkpointer, loader retry), and the CPU e2e the ISSUE demands: a
+``crash@N`` fault under ``--supervise`` restarts, resumes from the
+last good checkpoint, and finishes with state identical to an
+uninterrupted run; a deliberate crash-loop exhausts the budget and
+exits nonzero.
+"""
+
+import json
+import os
+import signal
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from distributed_training_tpu import telemetry
+from distributed_training_tpu.checkpoint import Checkpointer
+from distributed_training_tpu.config import Config
+from distributed_training_tpu.data import (ShardedDataLoader,
+                                           SyntheticRegressionDataset)
+from distributed_training_tpu.launch import local as launch_local_mod
+from distributed_training_tpu.models.mlp import MLP
+from distributed_training_tpu.resilience import faults, integrity
+from distributed_training_tpu.resilience import supervisor as sup
+from distributed_training_tpu.train.trainer import Trainer
+
+
+@pytest.fixture(autouse=True)
+def _fresh_ambient():
+    telemetry.uninstall()
+    yield
+    telemetry.uninstall()
+
+
+def _read_jsonl(path):
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+# -- supervisor: backoff --------------------------------------------------
+
+
+def test_backoff_exponential_capped_jittered():
+    p = sup.RestartPolicy(backoff_base_s=1.0, backoff_factor=2.0,
+                          backoff_max_s=10.0, jitter=0.2, seed=3)
+    # Within +/-20% of the exponential schedule, capped at max.
+    for n, base in [(1, 1.0), (2, 2.0), (3, 4.0), (4, 8.0),
+                    (5, 10.0), (9, 10.0)]:
+        d = p.backoff_s(n)
+        assert 0.8 * base <= d <= 1.2 * base, (n, d)
+    # Deterministic for a given seed; a different seed jitters apart.
+    assert p.backoff_s(2) == p.backoff_s(2)
+    p2 = sup.RestartPolicy(backoff_base_s=1.0, jitter=0.2, seed=4)
+    assert p.backoff_s(2) != p2.backoff_s(2)
+
+
+def test_backoff_zero_jitter_exact():
+    p = sup.RestartPolicy(backoff_base_s=0.5, backoff_factor=3.0,
+                          backoff_max_s=100.0, jitter=0.0)
+    assert [p.backoff_s(n) for n in (1, 2, 3)] == [0.5, 1.5, 4.5]
+
+
+# -- supervisor: exit classification --------------------------------------
+
+
+def test_classify_exit_precedence():
+    # rc 0, no sentinel: completed (process too old to write one).
+    assert sup.classify_exit(0, []) == sup.COMPLETED
+    # rc 0 + preempted sentinel: the ONLY way to tell these apart.
+    assert sup.classify_exit(
+        0, [{"outcome": sup.PREEMPTED}]) == sup.PREEMPTED
+    assert sup.classify_exit(
+        0, [{"outcome": sup.COMPLETED}]) == sup.COMPLETED
+    # Watchdog abort wins over everything, by sentinel or by rc 42.
+    assert sup.classify_exit(
+        1, [{"outcome": sup.WATCHDOG_ABORT}]) == sup.WATCHDOG_ABORT
+    assert sup.classify_exit(sup.WATCHDOG_EXIT_CODE,
+                             []) == sup.WATCHDOG_ABORT
+    # Signal deaths (launcher encodes as 128+signum): preemption shape.
+    assert sup.classify_exit(143, []) == sup.PREEMPTED
+    assert sup.classify_exit(130, []) == sup.PREEMPTED
+    # Anything else nonzero: crash.
+    assert sup.classify_exit(1, []) == sup.CRASH
+    assert sup.classify_exit(139, []) == sup.CRASH
+    # Worst report wins across a multi-process incarnation.
+    assert sup.classify_exit(0, [{"outcome": sup.COMPLETED},
+                                 {"outcome": sup.PREEMPTED}]) \
+        == sup.PREEMPTED
+    # ...including when one process reports preempted but the group rc
+    # is crash-shaped: a preemption verdict would REFUND the budget a
+    # real crash must burn.
+    assert sup.classify_exit(1, [{"outcome": sup.PREEMPTED}]) \
+        == sup.CRASH
+
+
+def test_exit_sentinel_roundtrip(tmp_path, monkeypatch):
+    base = str(tmp_path / "exit_0")
+    monkeypatch.setenv(sup.ENV_SENTINEL, base)
+    path = sup.write_exit_status(sup.PREEMPTED, step=40)
+    assert path and os.path.exists(path)
+    recs = sup.read_exit_statuses(base)
+    assert len(recs) == 1
+    assert recs[0]["outcome"] == sup.PREEMPTED
+    assert recs[0]["step"] == 40
+    # Unsupervised (no env): a silent no-op, not an error.
+    monkeypatch.delenv(sup.ENV_SENTINEL)
+    assert sup.write_exit_status(sup.COMPLETED) is None
+
+
+# -- supervisor: the loop --------------------------------------------------
+
+
+def _scripted_incarnations(script, ckpt_dir, pid="1"):
+    """Fake ``run_incarnation``: each call plays the next
+    (rc, sentinel_outcome, new_ckpt_step) entry — writing the exit
+    sentinel and fake checkpoint step dir the real launcher's children
+    would produce. ``pid`` distinguishes sentinel files the way real
+    child pids do across supervisor runs."""
+    calls = []
+
+    def run(extra_env):
+        i = min(len(calls), len(script) - 1)
+        calls.append(dict(extra_env))
+        rc, outcome, step = script[i]
+        base = extra_env[sup.ENV_SENTINEL]
+        if outcome is not None:
+            os.makedirs(os.path.dirname(base), exist_ok=True)
+            with open(f"{base}.pid{pid}.json", "w") as f:
+                json.dump({"outcome": outcome}, f)
+        if step is not None:
+            os.makedirs(os.path.join(ckpt_dir, str(step)),
+                        exist_ok=True)
+        return rc
+
+    run.calls = calls
+    return run
+
+
+def test_supervise_completes_first_try(tmp_path):
+    run = _scripted_incarnations([(0, sup.COMPLETED, None)],
+                                 str(tmp_path / "ckpt"))
+    res = sup.supervise(run, state_dir=str(tmp_path / "state"),
+                        sleep=lambda s: None)
+    assert res.returncode == 0
+    assert res.restarts == 0
+    assert res.incidents[0].outcome == sup.COMPLETED
+
+
+def test_supervise_progress_refunds_budget(tmp_path):
+    """Two crashes, each having advanced the checkpoint, survive a
+    max_restarts=1 budget — DISTINCT failures on a long healthy run
+    must not accumulate toward give-up."""
+    ckpt = str(tmp_path / "ckpt")
+    run = _scripted_incarnations(
+        [(1, None, 8), (1, None, 16), (0, sup.COMPLETED, None)], ckpt)
+    res = sup.supervise(
+        run, policy=sup.RestartPolicy(max_restarts=1),
+        state_dir=str(tmp_path / "state"), ckpt_dir=ckpt,
+        sleep=lambda s: None)
+    assert res.returncode == 0
+    assert res.restarts == 2
+    assert [i.advanced for i in res.incidents] == [True, True, False]
+    # Refund: budget back at max after each advancing failure.
+    assert [i.budget_after for i in res.incidents] == [1, 1, 1]
+
+
+def test_supervise_crash_loop_exhausts_budget(tmp_path):
+    """No checkpoint progress → every failure burns budget → exactly
+    max_restarts+1 incarnations, nonzero rc, give-up event."""
+    events = str(tmp_path / "sup_events.jsonl")
+    tel = telemetry.Telemetry(events_jsonl=events)
+    delays = []
+    run = _scripted_incarnations([(1, None, None)],
+                                 str(tmp_path / "ckpt"))
+    res = sup.supervise(
+        run, policy=sup.RestartPolicy(max_restarts=2,
+                                      backoff_base_s=0.5, jitter=0.0),
+        state_dir=str(tmp_path / "state"),
+        ckpt_dir=str(tmp_path / "ckpt"),
+        telemetry=tel, sleep=delays.append)
+    tel.close()
+    assert res.returncode == 1
+    assert len(res.incidents) == 3  # max_restarts + 1
+    assert res.incidents[-1].budget_after == -1
+    # Backoff escalated between non-advancing failures.
+    assert delays == [0.5, 1.0]
+    kinds = [e["kind"] for e in _read_jsonl(events)]
+    assert kinds.count("restart") == 2
+    assert "supervisor_give_up" in kinds
+    # The give-up summary names every incarnation.
+    assert len(res.summary_lines()) == 1 + 3
+
+
+def test_supervise_preemption_refunds_and_restarts(tmp_path):
+    """A clean preemption is the infrastructure's fault, not the
+    job's: it refunds budget and restarts (supervisor not stopped)."""
+    run = _scripted_incarnations(
+        [(0, sup.PREEMPTED, None), (0, sup.COMPLETED, None)],
+        str(tmp_path / "ckpt"))
+    res = sup.supervise(
+        run, policy=sup.RestartPolicy(max_restarts=0),
+        state_dir=str(tmp_path / "state"), sleep=lambda s: None)
+    assert res.returncode == 0
+    assert res.restarts == 1
+    assert res.incidents[0].outcome == sup.PREEMPTED
+    assert res.incidents[0].budget_after == 0  # refunded to max (0)
+
+
+def test_supervise_preemption_storm_backs_off(tmp_path):
+    """Preemptions without checkpoint progress keep refunding the
+    budget (unbounded retries are the point) but the backoff must
+    escalate — never a hot restart loop."""
+    run = _scripted_incarnations(
+        [(0, sup.PREEMPTED, None), (0, sup.PREEMPTED, None),
+         (0, sup.PREEMPTED, None), (0, sup.COMPLETED, None)],
+        str(tmp_path / "ckpt"))
+    delays = []
+    res = sup.supervise(
+        run, policy=sup.RestartPolicy(max_restarts=1,
+                                      backoff_base_s=0.5, jitter=0.0),
+        state_dir=str(tmp_path / "state"), sleep=delays.append)
+    assert res.returncode == 0
+    assert delays == [0.5, 1.0, 2.0]
+    assert all(i.budget_after == 1 for i in res.incidents[:3])
+
+
+def test_supervise_stop_requested_stands_down(tmp_path):
+    """When the LAUNCHER itself was signaled, the supervisor must not
+    restart the job the infrastructure just reclaimed."""
+    run = _scripted_incarnations([(0, sup.PREEMPTED, None)],
+                                 str(tmp_path / "ckpt"))
+    res = sup.supervise(run, state_dir=str(tmp_path / "state"),
+                        should_stop=lambda: True,
+                        sleep=lambda s: None)
+    assert len(res.incidents) == 1
+    assert len(run.calls) == 1
+
+
+def test_supervise_progress_survives_quarantine_lowered_step(tmp_path):
+    """A restore-time quarantine LOWERS the latest on-disk step; an
+    incarnation that then saves a NEW (but numerically lower) step is
+    real progress and must refund — an all-time high-water comparison
+    would burn budget on a recovering run."""
+    ckpt = str(tmp_path / "ckpt")
+    for s in ("100", "110"):
+        os.makedirs(os.path.join(ckpt, s))
+    calls = []
+
+    def run(extra_env):
+        calls.append(dict(extra_env))
+        if len(calls) == 1:
+            # The child's restore quarantined damaged step 110 and
+            # the run re-saved at 105 before crashing again.
+            os.rename(os.path.join(ckpt, "110"),
+                      os.path.join(ckpt, "step_110.corrupt"))
+            os.makedirs(os.path.join(ckpt, "105"))
+            return 1
+        base = extra_env[sup.ENV_SENTINEL]
+        with open(f"{base}.pid1.json", "w") as f:
+            json.dump({"outcome": sup.COMPLETED}, f)
+        return 0
+
+    res = sup.supervise(
+        run, policy=sup.RestartPolicy(max_restarts=0),
+        state_dir=str(tmp_path / "state"), ckpt_dir=ckpt,
+        sleep=lambda s: None)
+    assert res.returncode == 0
+    assert res.incidents[0].advanced  # 105 is NEW, despite 105 < 110
+    assert res.incidents[0].budget_after == 0  # refunded to max (0)
+
+
+def test_supervise_ignores_stale_sentinels_from_previous_run(tmp_path):
+    """Log dirs default to a constant path, so supervisor state_dirs
+    get reused across runs; a previous run's sentinels (different
+    pids) must not leak into this run's exit classification."""
+    state = str(tmp_path / "state")
+    run1 = _scripted_incarnations(
+        [(sup.WATCHDOG_EXIT_CODE, sup.WATCHDOG_ABORT, None)],
+        str(tmp_path / "ckpt"), pid="111")
+    res1 = sup.supervise(run1,
+                         policy=sup.RestartPolicy(max_restarts=0),
+                         state_dir=state, sleep=lambda s: None)
+    assert res1.returncode != 0  # watchdog-abort crash loop, gave up
+    # Same state_dir, new run (new pids): completes first try — the
+    # stale watchdog_abort sentinel at index 0 must not burn budget.
+    run2 = _scripted_incarnations([(0, sup.COMPLETED, None)],
+                                  str(tmp_path / "ckpt"), pid="222")
+    res2 = sup.supervise(run2,
+                         policy=sup.RestartPolicy(max_restarts=0),
+                         state_dir=state, sleep=lambda s: None)
+    assert res2.returncode == 0
+    assert res2.incidents[0].outcome == sup.COMPLETED
+
+
+def test_supervise_classifies_watchdog_abort(tmp_path):
+    run = _scripted_incarnations(
+        [(sup.WATCHDOG_EXIT_CODE, None, None),
+         (0, sup.COMPLETED, None)], str(tmp_path / "ckpt"))
+    res = sup.supervise(
+        run, policy=sup.RestartPolicy(max_restarts=1),
+        state_dir=str(tmp_path / "state"), sleep=lambda s: None)
+    assert res.returncode == 0
+    assert res.incidents[0].outcome == sup.WATCHDOG_ABORT
+
+
+# -- integrity: manifests --------------------------------------------------
+
+
+def _make_step_dir(tmp_path, step=8, payload=b"x" * 4096):
+    d = tmp_path / str(step)
+    (d / "state").mkdir(parents=True)
+    (d / "state" / "arrays.bin").write_bytes(payload)
+    (d / "meta.json").write_text('{"epoch": 1}')
+    return str(d)
+
+
+def test_manifest_roundtrip_and_damage_detection(tmp_path):
+    d = _make_step_dir(tmp_path)
+    integrity.write_manifest(d)
+    assert integrity.verify_manifest(d) == (True, [])
+    # Same-size content damage: caught by checksum.
+    faults.corrupt_step_dir(d)
+    verified, problems = integrity.verify_manifest(d)
+    assert verified and any("checksum mismatch" in p for p in problems)
+
+
+def test_manifest_catches_missing_extra_resized(tmp_path):
+    d = _make_step_dir(tmp_path)
+    integrity.write_manifest(d)
+    os.remove(os.path.join(d, "meta.json"))
+    with open(os.path.join(d, "state", "arrays.bin"), "ab") as f:
+        f.write(b"tail")
+    with open(os.path.join(d, "state", "extra.bin"), "wb") as f:
+        f.write(b"new")
+    _, problems = integrity.verify_manifest(d)
+    text = "\n".join(problems)
+    assert "missing file: meta.json" in text
+    assert "unexpected file: state/extra.bin" in text
+    assert "size mismatch: state/arrays.bin" in text
+
+
+def test_manifest_absent_is_unverified_not_condemned(tmp_path):
+    d = _make_step_dir(tmp_path)
+    assert integrity.verify_manifest(d) == (False, [])
+
+
+def test_unreadable_manifest_condemns(tmp_path):
+    d = _make_step_dir(tmp_path)
+    with open(os.path.join(d, integrity.MANIFEST_NAME), "w") as f:
+        f.write("{not json")
+    verified, problems = integrity.verify_manifest(d)
+    assert verified and problems
+
+
+def test_step_scan_ignores_non_numeric_and_quarantined(tmp_path):
+    for name in ("8", "16", "24"):
+        (tmp_path / name).mkdir()
+    (tmp_path / "16.orbax-checkpoint-tmp-123").mkdir()
+    (tmp_path / "step_24.corrupt").mkdir()
+    (tmp_path / "consolidated_step24.msgpack").write_bytes(b"")
+    assert integrity.checkpoint_steps_on_disk(str(tmp_path)) == \
+        [8, 16, 24]
+    assert integrity.latest_step_on_disk(str(tmp_path)) == 24
+    assert integrity.latest_step_on_disk(
+        str(tmp_path / "nonexistent")) is None
+
+
+def test_quarantine_renames_and_survives_collisions(tmp_path):
+    _make_step_dir(tmp_path, step=8)
+    dst = integrity.quarantine_step(str(tmp_path), 8, ["bad"])
+    assert dst.endswith("step_8.corrupt") and os.path.isdir(dst)
+    assert not os.path.exists(tmp_path / "8")
+    # A later incarnation condemning a NEW step 8 must not collide.
+    _make_step_dir(tmp_path, step=8)
+    dst2 = integrity.quarantine_step(str(tmp_path), 8, ["bad again"])
+    assert dst2.endswith("step_8.corrupt.2")
+    # Step already gone (lost the rename race): not an error.
+    assert integrity.quarantine_step(str(tmp_path), 8) is None
+
+
+# -- faults: plan parsing --------------------------------------------------
+
+
+def test_fault_plan_full_grammar():
+    plan = faults.parse_fault_plan(
+        "crash@40,sigterm@80,corrupt_ckpt@120,"
+        "data_stall@60:500ms,data_error@70,crash@90:always")
+    by_key = {f.key: f for f in plan}
+    assert by_key["crash@40"].always is False
+    assert by_key["crash@90"].always is True
+    assert by_key["data_stall@60"].stall_s == 0.5
+    assert by_key["sigterm@80"].step == 80
+    # Empty entries (trailing comma) tolerated; empty plan is empty.
+    assert faults.parse_fault_plan("crash@40,") == \
+        faults.parse_fault_plan("crash@40")
+    assert faults.parse_fault_plan("") == ()
+
+
+@pytest.mark.parametrize("bad", [
+    "crash",                  # no @step
+    "crash@",                 # no step
+    "meteor@40",              # unknown kind
+    "crash@0",                # step must be >= 1
+    "crash@40,crash@40",      # duplicate incident
+    "data_stall@60",          # stall needs a duration
+    "crash@40:500ms",         # duration on a non-stall fault
+    "data_stall@60:500",      # unitless duration
+])
+def test_fault_plan_rejects_malformed(bad):
+    with pytest.raises(faults.FaultPlanError):
+        faults.parse_fault_plan(bad)
+
+
+def test_parse_duration():
+    assert faults.parse_duration_s("500ms") == 0.5
+    assert faults.parse_duration_s("2s") == 2.0
+    assert faults.parse_duration_s("1.5s") == 1.5
+    with pytest.raises(faults.FaultPlanError):
+        faults.parse_duration_s("5m")
+
+
+# -- faults: injector ------------------------------------------------------
+
+
+def test_injector_crash_is_one_shot_across_restarts(tmp_path):
+    ledger = str(tmp_path / "faults_fired.json")
+    inj = faults.FaultInjector("crash@5", ledger_path=ledger)
+    inj.on_step(4)  # not due yet
+    with pytest.raises(faults.InjectedCrash):
+        inj.on_step(5)
+    # The ledger was written BEFORE the raise: a restarted injector
+    # (new process, same ledger) replaying step 5 must not re-fire.
+    inj2 = faults.FaultInjector("crash@5", ledger_path=ledger)
+    inj2.on_step(5)
+    assert inj2.fired == {"crash@5"}
+
+
+def test_injector_always_refires(tmp_path):
+    ledger = str(tmp_path / "faults_fired.json")
+    for _ in range(2):  # every "incarnation" crashes again
+        inj = faults.FaultInjector("crash@5:always",
+                                   ledger_path=ledger)
+        with pytest.raises(faults.InjectedCrash):
+            inj.on_step(5)
+
+
+def test_injector_sigterm_delivers_signal():
+    got = []
+    prev = signal.signal(signal.SIGTERM,
+                         lambda s, f: got.append(s))
+    try:
+        inj = faults.FaultInjector("sigterm@3")
+        inj.on_step(3)
+    finally:
+        signal.signal(signal.SIGTERM, prev)
+    assert got == [signal.SIGTERM]
+
+
+def test_injector_data_error_and_stall(tmp_path):
+    inj = faults.FaultInjector("data_error@2,data_stall@3:10ms")
+    inj.on_data(1)
+    with pytest.raises(faults.InjectedDataError):
+        inj.on_data(2)
+    assert isinstance(faults.InjectedDataError("x"), OSError)
+    t0 = time.monotonic()
+    inj.on_data(3)  # sleeps 10ms
+    assert time.monotonic() - t0 >= 0.01
+    inj.on_data(3)  # one-shot: no second stall
+    assert inj.fired == {"data_error@2", "data_stall@3"}
+
+
+def test_injector_corrupts_latest_committed_checkpoint(tmp_path):
+    step_dir = _make_step_dir(tmp_path, step=8)
+    integrity.write_manifest(step_dir)
+    inj = faults.FaultInjector("corrupt_ckpt@5",
+                               ckpt_dir=str(tmp_path))
+    # Fires at the FIRST save with step >= 5, not an exact match.
+    inj.on_checkpoint_saved(8)
+    _, problems = integrity.verify_manifest(step_dir)
+    assert problems, "injected corruption not detected by manifest"
+    assert inj.fired == {"corrupt_ckpt@5"}
+
+
+def test_injector_only_corrupts_manifested_steps(tmp_path):
+    """Damaging a not-yet-manifested step would let the later
+    manifest flush checksum the corrupted bytes and BLESS them; the
+    injector must target the newest MANIFESTED step (and stay armed
+    while none exists)."""
+    unmanifested = _make_step_dir(tmp_path, step=16)
+    inj = faults.FaultInjector("corrupt_ckpt@5",
+                               ckpt_dir=str(tmp_path))
+    inj.on_checkpoint_saved(16)
+    assert inj.fired == set()  # no eligible victim yet: stays armed
+    manifested = _make_step_dir(tmp_path, step=8)
+    integrity.write_manifest(manifested)
+    inj.on_checkpoint_saved(24)
+    assert inj.fired == {"corrupt_ckpt@5"}
+    # The older-but-manifested step took the damage...
+    _, problems = integrity.verify_manifest(manifested)
+    assert problems
+    # ...and the unmanifested one is untouched.
+    assert integrity.verify_manifest(unmanifested) == (False, [])
+
+
+def test_async_checkpointer_corruption_is_always_detectable(tmp_path):
+    """End-to-end ordering with ASYNC saves (the CLI default): the
+    fault must land on a step whose manifest predates the damage, so
+    verification catches it — never a step manifested afterwards."""
+    state = {"a": np.arange(64, dtype=np.float32)}
+    inj = faults.FaultInjector("corrupt_ckpt@1",
+                               ledger_path=str(tmp_path / "led.json"))
+    with Checkpointer(str(tmp_path / "ckpt"), async_save=True,
+                      fault_injector=inj) as ckpt:
+        assert ckpt.save(1, state, meta={"epoch": 0})
+        # save(1) is async: step 1 has no manifest yet, so the fault
+        # stays armed instead of corrupting a future-blessed step.
+        assert inj.fired == set()
+        assert ckpt.save(2, state, meta={"epoch": 1})
+        # save(2) committed+manifested step 1 first; THEN the fault
+        # fired against it.
+        assert inj.fired == {"corrupt_ckpt@1"}
+    d1 = str(tmp_path / "ckpt" / "1")
+    d2 = str(tmp_path / "ckpt" / "2")
+    _, problems = integrity.verify_manifest(d1)
+    assert problems, "corruption blessed by a post-damage manifest"
+    assert integrity.verify_manifest(d2) == (True, [])
+
+
+# -- checkpointer: integrity + fallback chain (real orbax) ----------------
+
+
+def _build_trainer(rt, tmp_path, epochs=3):
+    cfg = Config()
+    cfg.train.total_epochs = epochs
+    cfg.train.save_every = 1
+    cfg.train.batch_size = 4
+    cfg.train.dataset_size = 64
+    cfg.train.log_every = 0
+    cfg.train.snapshot_path = str(tmp_path / "ckpt")
+    ds = SyntheticRegressionDataset(size=64, seed=0, kind="linear")
+    loader = ShardedDataLoader(ds, rt, batch_size=4,
+                               seed=cfg.train.seed)
+    model = MLP(input_size=20, output_size=1)
+    ckpt = Checkpointer(cfg.train.snapshot_path, async_save=False)
+    return Trainer(cfg, rt, model, loader, ckpt), ckpt, cfg
+
+
+def test_saves_write_manifests(cpu8, tmp_path):
+    trainer, ckpt, _ = _build_trainer(cpu8, tmp_path, epochs=2)
+    trainer.train()
+    ckpt.close()
+    steps = integrity.checkpoint_steps_on_disk(str(tmp_path / "ckpt"))
+    assert steps, "no checkpoints written"
+    for step in steps:
+        d = str(tmp_path / "ckpt" / str(step))
+        assert integrity.verify_manifest(d) == (True, []), step
+
+
+def test_restore_falls_back_past_corrupt_latest(cpu8, tmp_path):
+    """The acceptance scenario: latest checkpoint deliberately
+    corrupted → restore quarantines it (event emitted) and resumes
+    from the previous good step instead of raising."""
+    trainer, ckpt, _ = _build_trainer(cpu8, tmp_path, epochs=3)
+    trainer.train()
+    steps = integrity.checkpoint_steps_on_disk(str(tmp_path / "ckpt"))
+    ckpt.close()
+    faults.corrupt_step_dir(str(tmp_path / "ckpt" / str(steps[-1])))
+
+    events = str(tmp_path / "events.jsonl")
+    telemetry.install(telemetry.Telemetry(events_jsonl=events))
+    trainer2, ckpt2, _ = _build_trainer(cpu8, tmp_path, epochs=3)
+    ckpt2.close()
+    # Resumed from the NEXT-OLDER good step, not fresh.
+    assert int(trainer2.state["step"]) == steps[-2]
+    assert trainer2.epochs_run == 2
+    # The condemned step is quarantined, not deleted.
+    assert os.path.isdir(
+        tmp_path / "ckpt" / f"step_{steps[-1]}.corrupt")
+    assert not os.path.exists(tmp_path / "ckpt" / str(steps[-1]))
+    quar = [e for e in _read_jsonl(events)
+            if e["kind"] == "ckpt_quarantined"]
+    assert len(quar) == 1 and quar[0]["step"] == steps[-1]
+    assert quar[0]["problems"]
+
+
+def test_restore_fresh_start_when_every_step_corrupt(cpu8, tmp_path):
+    trainer, ckpt, _ = _build_trainer(cpu8, tmp_path, epochs=2)
+    trainer.train()
+    ckpt.close()
+    steps = integrity.checkpoint_steps_on_disk(str(tmp_path / "ckpt"))
+    for s in steps:
+        faults.corrupt_step_dir(str(tmp_path / "ckpt" / str(s)))
+    trainer2, ckpt2, _ = _build_trainer(cpu8, tmp_path, epochs=2)
+    ckpt2.close()
+    assert trainer2.epochs_run == 0
+    assert int(trainer2.state["step"]) == 0
+    assert integrity.checkpoint_steps_on_disk(
+        str(tmp_path / "ckpt")) == []
+
+
+def test_restore_quarantines_on_orbax_failure(cpu8, tmp_path):
+    """A step whose manifest is gone AND whose payload orbax cannot
+    read (legacy checkpoint damaged in place) falls back via the
+    restore-exception path, not a crash."""
+    import shutil
+    trainer, ckpt, _ = _build_trainer(cpu8, tmp_path, epochs=2)
+    trainer.train()
+    ckpt.close()
+    steps = integrity.checkpoint_steps_on_disk(str(tmp_path / "ckpt"))
+    latest = str(tmp_path / "ckpt" / str(steps[-1]))
+    os.remove(os.path.join(latest, integrity.MANIFEST_NAME))
+    shutil.rmtree(os.path.join(latest, "state"))
+    trainer2, ckpt2, _ = _build_trainer(cpu8, tmp_path, epochs=2)
+    ckpt2.close()
+    assert int(trainer2.state["step"]) == steps[-2]
+    assert os.path.isdir(
+        tmp_path / "ckpt" / f"step_{steps[-1]}.corrupt")
+
+
+def test_checkpointer_context_manager_drains_async_save(tmp_path):
+    """__exit__ must wait() (manifests flushed, save durable) and
+    close() on every exit path — here the normal one."""
+    state = {"a": np.arange(32, dtype=np.float32)}
+    with Checkpointer(str(tmp_path / "ckpt"),
+                      async_save=True) as ckpt:
+        assert ckpt.save(1, state, meta={"epoch": 0})
+    d = str(tmp_path / "ckpt" / "1")
+    assert os.path.isdir(d)
+    assert integrity.verify_manifest(d) == (True, [])
+
+
+# -- loader: bounded retry -------------------------------------------------
+
+
+def _tiny_loader(rt, **kw):
+    ds = SyntheticRegressionDataset(size=32, seed=0, kind="linear")
+    return ShardedDataLoader(ds, rt, batch_size=4, shuffle=False,
+                             prefetch_depth=0, **kw)
+
+
+def test_loader_retries_transient_errors(cpu8, tmp_path):
+    events = str(tmp_path / "events.jsonl")
+    telemetry.install(telemetry.Telemetry(events_jsonl=events))
+    loader = _tiny_loader(cpu8, data_retries=2)
+    real = loader._assemble
+    blips = {"left": 2}
+
+    def flaky(rows):
+        if blips["left"]:
+            blips["left"] -= 1
+            raise OSError("synthetic io blip")
+        return real(rows)
+
+    loader._assemble = flaky
+    batches = list(loader.epoch(0))
+    assert len(batches) == loader.steps_per_epoch
+    retries = [e for e in _read_jsonl(events)
+               if e["kind"] == "data_retry"]
+    assert len(retries) == 2
+    assert retries[0]["attempt"] == 1 and retries[1]["attempt"] == 2
+    assert "OSError" in retries[0]["error"]
+
+
+def test_loader_retry_budget_exhausts(cpu8):
+    loader = _tiny_loader(cpu8, data_retries=1)
+
+    def always_fails(rows):
+        raise OSError("persistent failure")
+
+    loader._assemble = always_fails
+    with pytest.raises(OSError, match="persistent failure"):
+        list(loader.epoch(0))
+
+
+def test_loader_fatal_errors_not_retried(cpu8):
+    loader = _tiny_loader(cpu8, data_retries=5)
+    calls = {"n": 0}
+
+    def malformed(rows):
+        calls["n"] += 1
+        raise ValueError("malformed sample")
+
+    loader._assemble = malformed
+    with pytest.raises(ValueError):
+        list(loader.epoch(0))
+    assert calls["n"] == 1  # no retry: bad data won't improve
+
+
+def test_loader_injected_data_error_recovers(cpu8, tmp_path):
+    """The fault hook runs INSIDE the retry loop: an injected
+    transient exercises exactly the real recovery path."""
+    inj = faults.FaultInjector(
+        "data_error@1", ledger_path=str(tmp_path / "ledger.json"))
+    loader = _tiny_loader(cpu8, data_retries=2, fault_injector=inj)
+    batches = list(loader.epoch(0))
+    assert len(batches) == loader.steps_per_epoch
+    assert inj.fired == {"data_error@1"}
+
+
+# -- launcher: signal forwarding ------------------------------------------
+
+
+def test_wait_forwards_sigterm_to_children(tmp_path):
+    """When the LAUNCHER is signaled mid-wait, children must receive
+    the signal (their PreemptionGuard path) and the launcher reaps
+    them cleanly instead of orphaning them."""
+    procs = launch_local_mod.launch_local(
+        ["-c",
+         "import signal, sys, time\n"
+         "signal.signal(signal.SIGTERM, lambda *a: sys.exit(0))\n"
+         "time.sleep(600)\n"],
+        num_processes=1, log_dir=str(tmp_path))
+    timer = threading.Timer(
+        0.5, signal.raise_signal, [signal.SIGTERM])
+    timer.start()
+    try:
+        code = launch_local_mod.wait(procs, timeout=60)
+    finally:
+        timer.cancel()
+        launch_local_mod._launcher_signaled = False
+    assert code == 0  # child exited 0 FROM ITS HANDLER, not killed
+
+
+# -- summarizer: recovery accounting --------------------------------------
+
+
+def test_recovery_counts_fresh_start_restart():
+    """A crash BEFORE the first checkpoint restarts into a fresh
+    incarnation (resume at step 0) — still an incident; only
+    resume-less appended sessions (offline eval) are excluded."""
+    from distributed_training_tpu.telemetry.summarize import _recovery
+    events = [
+        {"kind": "run_start", "t": 100.0, "step": 0},
+        {"kind": "span", "t": 105.0, "name": "step", "step": 10},
+        {"kind": "run_start", "t": 120.0, "step": 0},
+        {"kind": "resume", "t": 121.0, "step": 0, "restarts": 1},
+    ]
+    rec = _recovery(events)
+    assert rec["restarts"] == 1
+    inc = rec["incidents"][0]
+    assert inc["resumed_at_step"] == 0
+    assert inc["steps_lost"] == 10
+    assert inc["time_to_recover_s"] == 15.0
+    # An appended session with no resume (offline eval) is NOT one.
+    rec2 = _recovery(events + [
+        {"kind": "run_start", "t": 300.0, "step": 20},
+        {"kind": "eval_result", "t": 301.0, "loss": 1.0},
+    ])
+    assert rec2["restarts"] == 1
+
+
+# -- e2e: crash → supervised restart → resume → identical result ----------
+
+
+def _train_overrides(out_dir, snap, **extra):
+    over = {
+        "run.output_dir": out_dir,
+        "train.snapshot_path": snap,
+        "train.total_epochs": 4,
+        "train.dataset_size": 32,
+        "train.batch_size": 4,
+        "train.log_every": 0,
+        "train.save_every": 1,
+    }
+    over.update(extra)
+    return [f"{k}={v}" for k, v in over.items()]
+
+
+def test_supervised_crash_restart_resume_e2e(tmp_path):
+    """The acceptance loop, end to end on CPU: `crash@20` under
+    `--supervise` kills incarnation 0 mid-epoch-2; the supervisor
+    restarts, the run resumes from the last good checkpoint (step 16)
+    and completes all 4 epochs with final state IDENTICAL to an
+    uninterrupted run. ~40s: three ~12s python+jax subprocesses."""
+    from distributed_training_tpu.checkpoint.export import (
+        restore_step_local)
+    from distributed_training_tpu.telemetry.summarize import (
+        summarize_run)
+
+    faulty = tmp_path / "faulty"
+    rc = launch_local_mod.main([
+        "--nproc", "1", "--devices-per-proc", "1",
+        "--log-dir", str(faulty / "logs"),
+        "--supervise", "--max-restarts", "2",
+        "--backoff-base-s", "0.05",
+        "--ckpt-dir", str(faulty / "ckpt"),
+        "--", "-m", "distributed_training_tpu.train",
+        *_train_overrides(str(faulty / "out"), str(faulty / "ckpt")),
+        "train.fault_plan=crash@20",
+    ])
+    assert rc == 0, "supervised run did not recover"
+
+    # The supervisor saw exactly one crash and restarted once.
+    sup_events = _read_jsonl(
+        str(faulty / "logs" / "supervisor" / "events.jsonl"))
+    restarts = [e for e in sup_events if e["kind"] == "restart"]
+    assert len(restarts) == 1
+    assert restarts[0]["outcome"] == sup.CRASH
+    assert restarts[0]["ckpt_step"] == 16 and restarts[0]["advanced"]
+
+    # The run's own stream: fault fired once, resume from step 16.
+    run_dir = str(faulty / "out" / "default")
+    events = _read_jsonl(os.path.join(run_dir, "events.jsonl"))
+    fired = [e for e in events if e["kind"] == "fault_injected"]
+    assert [e["fault"] for e in fired] == ["crash@20"]
+    resumes = [e for e in events if e["kind"] == "resume"]
+    assert len(resumes) == 1
+    assert resumes[0]["step"] == 16 and resumes[0]["restarts"] == 1
+
+    # Summarizer recovery table: 1 restart, 4 steps lost (17..20).
+    rec = summarize_run(run_dir)["recovery"]
+    assert rec["restarts"] == 1
+    assert rec["incidents"][0]["resumed_at_step"] == 16
+    assert rec["incidents"][0]["steps_lost"] == 4
+
+    # Uninterrupted reference run with the same config and seed.
+    clean = tmp_path / "clean"
+    procs = launch_local_mod.launch_local(
+        ["-m", "distributed_training_tpu.train",
+         *_train_overrides(str(clean / "out"), str(clean / "ckpt"))],
+        num_processes=1, devices_per_process=1,
+        log_dir=str(clean / "logs"))
+    assert launch_local_mod.wait(procs, timeout=180) == 0
+
+    got, got_step = restore_step_local(str(faulty / "ckpt"))
+    want, want_step = restore_step_local(str(clean / "ckpt"))
+    assert got_step == want_step == 32
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b)),
+        got["params"], want["params"])
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b)),
+        got["opt_state"], want["opt_state"])
+
+
+def test_restart_incarnation_without_checkpoint_appends_stream(
+        tmp_path):
+    """A supervised restart that finds NO checkpoint (the crash
+    predated the first save) must APPEND to the run's event stream —
+    truncating would destroy the crashed segment's evidence — and
+    must still emit a step-0 resume event for the recovery table."""
+    out = tmp_path / "out"
+    run_dir = out / "default"
+    run_dir.mkdir(parents=True)
+    marker = {"kind": "run_start", "t": 1.0, "step": 0,
+              "crashed_segment_marker": True}
+    with open(run_dir / "events.jsonl", "w") as f:
+        f.write(json.dumps(marker) + "\n")
+    procs = launch_local_mod.launch_local(
+        ["-m", "distributed_training_tpu.train",
+         *_train_overrides(str(out), str(tmp_path / "ckpt"))],
+        num_processes=1, devices_per_process=1,
+        log_dir=str(tmp_path / "logs"),
+        env={sup.ENV_RESTART_COUNT: "1"})
+    assert launch_local_mod.wait(procs, timeout=180) == 0
+    events = _read_jsonl(str(run_dir / "events.jsonl"))
+    assert events[0].get("crashed_segment_marker"), \
+        "restart incarnation truncated the event stream"
+    resumes = [e for e in events if e["kind"] == "resume"]
+    assert len(resumes) == 1
+    assert resumes[0]["step"] == 0 and resumes[0]["restarts"] == 1
+
+
+def test_supervised_crash_loop_gives_up_e2e(tmp_path):
+    """A fault that re-fires every restart must exhaust the budget and
+    exit nonzero with the crashing child's rc — fast child (no jax),
+    so this proves the launcher wiring in ~2s."""
+    rc = launch_local_mod.main([
+        "--nproc", "1",
+        "--log-dir", str(tmp_path / "logs"),
+        "--supervise", "--max-restarts", "1",
+        "--backoff-base-s", "0.01",
+        "--", "-c", "import sys; sys.exit(7)",
+    ])
+    assert rc == 7
+    sup_events = _read_jsonl(
+        str(tmp_path / "logs" / "supervisor" / "events.jsonl"))
+    kinds = [e["kind"] for e in sup_events]
+    assert kinds.count("restart") == 1
+    give_up = [e for e in sup_events
+               if e["kind"] == "supervisor_give_up"]
+    assert give_up and give_up[0]["incarnations"] == 2

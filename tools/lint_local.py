@@ -25,6 +25,16 @@ is red when a violation lands:
   ``tests/`` is exempt (fixtures hand-write synthetic streams);
   derived artifacts (postmortem event tails, merged timelines) carry
   an inline ``# noqa``.
+- DTT002 (repo rule): a broad silent swallow — ``except:`` /
+  ``except Exception:`` / ``except BaseException:`` whose body is
+  only ``pass``. Silent swallows are how recovery bugs hide
+  (resilience/: a quarantine that "succeeds" by eating its own
+  OSError is indistinguishable from one that worked). Handlers that
+  genuinely must swallow (best-effort postmortem paths) either log a
+  breadcrumb or carry ``# noqa: DTT002`` on the ``except`` line, or
+  their file is named in ``DTT002_ALLOWLIST``. Narrow handlers
+  (``except FileNotFoundError: pass``) are fine — naming the
+  exception is the evidence the swallow was a decision.
 - black / mypy: NOT locally enforceable without the tools; they
   remain CI-only. This file documents that boundary explicitly
   instead of pretending coverage.
@@ -56,6 +66,22 @@ JSONL_SINKS = {
     os.path.join("distributed_training_tpu", "utils", "metrics.py"),
 }
 _WRITE_CHARS = set("wax+")
+
+# DTT002: files allowed to contain broad `except ...: pass` swallows.
+# Deliberately empty — every current swallow either logs a breadcrumb
+# or carries an inline `# noqa: DTT002` with its justification; add a
+# path here only when a whole file is best-effort by design.
+DTT002_ALLOWLIST: set[str] = set()
+_BROAD_EXC_NAMES = {"Exception", "BaseException"}
+
+
+def _noqa_allows(lines: list[str], lineno: int, code: str) -> bool:
+    """flake8 noqa scoping: a bare ``# noqa`` suppresses everything,
+    ``# noqa: CODE[,CODE]`` only the named codes."""
+    if not (0 < lineno <= len(lines)):
+        return False
+    m = re.search(r"#\s*noqa(?::\s*([A-Z0-9, ]+))?", lines[lineno - 1])
+    return bool(m and (m.group(1) is None or code in m.group(1)))
 
 
 def iter_py_files(root: str = REPO):
@@ -177,16 +203,42 @@ def check_file(path: str) -> list[str]:
             # flake8 noqa semantics: a bare `# noqa` suppresses
             # everything, `# noqa: CODE[,CODE]` only the named codes —
             # an unrelated `# noqa: E501` must not disable this rule.
-            if node.lineno - 1 < len(lines):
-                m = re.search(r"#\s*noqa(?::\s*([A-Z0-9, ]+))?",
-                              lines[node.lineno - 1])
-                if m and (m.group(1) is None
-                          or "DTT001" in m.group(1)):
-                    continue
+            if _noqa_allows(lines, node.lineno, "DTT001"):
+                continue
             problems.append(
                 f"{rel}:{node.lineno}: DTT001 write-mode open() of a "
                 "jsonl stream outside the telemetry sink — emit "
                 "through telemetry/events.py (host tagging)")
+
+    # DTT002: broad silent swallow. `except Exception: pass` (or bare
+    # except / BaseException) discards failure evidence — in a
+    # codebase whose failure model is crash-restart-resume, that is
+    # how recovery bugs hide. Either narrow the exception, log a
+    # breadcrumb, or justify with `# noqa: DTT002` on the except line.
+    if rel not in DTT002_ALLOWLIST:
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if not all(isinstance(s, ast.Pass) for s in node.body):
+                continue
+            t = node.type
+            names = []
+            if t is None:
+                names = ["<bare>"]
+            elif isinstance(t, ast.Name):
+                names = [t.id]
+            elif isinstance(t, ast.Tuple):
+                names = [e.id for e in t.elts
+                         if isinstance(e, ast.Name)]
+            if not any(n == "<bare>" or n in _BROAD_EXC_NAMES
+                       for n in names):
+                continue
+            if _noqa_allows(lines, node.lineno, "DTT002"):
+                continue
+            problems.append(
+                f"{rel}:{node.lineno}: DTT002 silent broad exception "
+                "swallow (`except Exception: pass`) — narrow it, log "
+                "a breadcrumb, or noqa with justification")
 
     # isort subset (default/black-profile semantics): sections ordered
     # future < stdlib < third-party < first-party < relative; within a
