@@ -17,6 +17,14 @@ step — so the communication contract is a testable artifact.
     python benchmarks/audit_collectives.py --devices 8 --mesh tp=2,sp=2,fsdp=2
 
 Prints a human table to stderr and one JSON summary line to stdout.
+
+This is a THIN WRAPPER: the HLO parser lives in
+``telemetry/collectives.py`` (stable ``schema`` consumed by
+trainer-emitted events and the multi-host aggregator), the abstract
+trainer/compile machinery in ``analysis/compile.py`` (shared with the
+SPMD auditor and precompile_points), and the table rendering is the
+same ``render_lines`` every other report uses — so none of the three
+can drift from this CLI at the next SCHEMA bump.
 """
 
 from __future__ import annotations
@@ -43,121 +51,18 @@ if "xla_force_host_platform_device_count" not in _flags:
     ).strip()
 os.environ["JAX_PLATFORMS"] = "cpu"
 
-# The HLO parser lives in the telemetry library now (stable schema,
-# consumed by trainer-emitted `collectives` events and the multi-host
-# aggregator); this CLI keeps the audit UX. Imported AFTER the env
-# block above — the package import chain pulls in jax.
+# Imported AFTER the env block above — the package import chain pulls
+# in jax. Re-exports kept on purpose: contract tests parse HLO via
+# this module, precompile_points warms the cache via
+# lower_abstract_step.
+from distributed_training_tpu.analysis.compile import (  # noqa: E402,F401 — re-exported shared helpers
+    compile_step_hlo,
+    lower_abstract_step,
+)
 from distributed_training_tpu.telemetry.collectives import (  # noqa: E402,F401 — re-exported: contract tests parse HLO via this module
     audit_hlo_text,
+    render_lines,
 )
-
-
-def lower_abstract_step(topology: str, n_devices: int, strategy: str,
-                        model_name: str, model_kwargs: dict,
-                        batch_size: int, seq_len: int,
-                        mesh_axes: dict | None = None,
-                        train_overrides: dict | None = None):
-    """Build the abstract Trainer against a DEVICE-LESS TPU topology
-    and return the Lowered train step (zero materialized state).
-
-    The one shared implementation of the topology-AOT setup — both the
-    collective audit below and benchmarks/precompile_points.py go
-    through it, so the trainer/batch construction cannot drift between
-    the audit and the cache warm-up."""
-    import jax
-
-    jax.config.update("jax_platforms", "cpu")
-    import jax.numpy as jnp
-    import numpy as np
-
-    from distributed_training_tpu.config import Config
-    from distributed_training_tpu.data import (ShardedDataLoader,
-                                               SyntheticLMDataset)
-    from distributed_training_tpu.models import build_model
-    from distributed_training_tpu.runtime import topology_runtime
-    from distributed_training_tpu.train.trainer import Trainer
-
-    cfg = Config()
-    cfg.train.parallel_strategy = strategy
-    cfg.train.batch_size = batch_size
-    cfg.train.log_every = 0
-    for k, v in (train_overrides or {}).items():
-        setattr(cfg.train, k, v)
-    rt = topology_runtime(n_devices, topology, **(mesh_axes or {}))
-    model = build_model(model_name, **model_kwargs)
-    ds = SyntheticLMDataset(
-        size=max(64, batch_size),
-        seq_len=seq_len,
-        vocab_size=min(model.cfg.vocab_size, 50257), seed=0)
-    loader = ShardedDataLoader(ds, rt, batch_size=batch_size,
-                               shuffle=False)
-    trainer = Trainer(cfg, rt, model, loader, abstract=True)
-    sample = ds.batch(np.arange(1))
-    batch = {
-        k: jax.ShapeDtypeStruct(
-            (loader.global_batch,) + v.shape[1:], v.dtype,
-            sharding=trainer.batch_sharding)
-        for k, v in sample.items()}
-    return trainer._step_fn.lower(trainer.state, batch,
-                                  jnp.zeros((2,), jnp.uint32))
-
-
-def compile_step_hlo(n_devices: int, strategy: str,
-                     mesh_axes: dict | None = None,
-                     model_kwargs: dict | None = None,
-                     tpu_topology: str | None = None,
-                     seq_len: int = 32) -> str:
-    """Build the real Trainer on a virtual mesh and return the
-    compiled (SPMD-partitioned) HLO of its jitted train step.
-
-    ``tpu_topology`` (e.g. "v5e:2x2") compiles with the REAL TPU
-    compiler against a device-less topology descriptor instead of the
-    CPU backend — the partitioning passes differ (the TPU pipeline
-    runs reduce-scatter-creator; CPU lowers FSDP grad sync as
-    all-reduce + dynamic-slice), so contract claims about what runs
-    on hardware must audit this path (VERDICT r4 item 4)."""
-    import jax
-
-    jax.config.update("jax_platforms", "cpu")
-
-    from distributed_training_tpu.config import Config
-    from distributed_training_tpu.data import (ShardedDataLoader,
-                                               SyntheticLMDataset)
-    from distributed_training_tpu.models import build_model
-    from distributed_training_tpu.runtime import fake_cpu_runtime
-    from distributed_training_tpu.train.trainer import Trainer
-
-    mk = dict(vocab_size=256, d_model=64, n_layers=2, n_heads=4,
-              max_seq_len=64, dtype="float32")
-    mk.update(model_kwargs or {})
-    if tpu_topology:
-        lowered = lower_abstract_step(
-            tpu_topology, n_devices, strategy, "transformer", mk,
-            batch_size=2 * n_devices, seq_len=seq_len,
-            mesh_axes=mesh_axes,
-            train_overrides=dict(min_shard_elems=1, dtype="float32"))
-        return lowered.compile().as_text()
-
-    cfg = Config()
-    cfg.train.parallel_strategy = strategy
-    cfg.train.batch_size = 2 * n_devices
-    cfg.train.log_every = 0
-    cfg.train.min_shard_elems = 1
-    cfg.train.dtype = "float32"
-    rt = fake_cpu_runtime(n_devices, **(mesh_axes or {}))
-    model = build_model("transformer", **mk)
-    ds = SyntheticLMDataset(size=max(64, cfg.train.batch_size),
-                            seq_len=seq_len, vocab_size=256, seed=0)
-    loader = ShardedDataLoader(ds, rt, batch_size=cfg.train.batch_size,
-                               shuffle=False)
-    import jax.numpy as jnp
-
-    trainer = Trainer(cfg, rt, model, loader)
-    batch = next(iter(loader.epoch(0)))
-
-    lowered = trainer._step_fn.lower(trainer.state, batch,
-                                     jnp.zeros((2,), jnp.uint32))
-    return lowered.compile().as_text()
 
 
 def main() -> int:
@@ -185,10 +90,8 @@ def main() -> int:
     rep["strategy"] = args.strategy
     rep["mesh"] = mesh_axes
     rep["tpu_topology"] = args.tpu_topology
-    for kind, row in sorted(rep["by_kind"].items(),
-                            key=lambda kv: -kv[1]["bytes"]):
-        print(f"{kind:20s} x{row['count']:3d}  "
-              f"{row['bytes'] / 1e6:9.3f} MB", file=sys.stderr)
+    for line in render_lines(rep):
+        print(line, file=sys.stderr)
     print(json.dumps(rep))
     return 0
 

@@ -68,10 +68,10 @@ def time_shape(m: int, k: int, n: int, cycles: int) -> tuple[float, bool]:
     import jax
     import jax.numpy as jnp
 
-    key = jax.random.PRNGKey(0)
-    x0 = jax.random.normal(key, (m, k), dtype=jnp.bfloat16)
-    b = jax.random.normal(key, (k, n), dtype=jnp.bfloat16)
-    c = jax.random.normal(key, (n, k), dtype=jnp.bfloat16)
+    kx, kb, kc = jax.random.split(jax.random.PRNGKey(0), 3)
+    x0 = jax.random.normal(kx, (m, k), dtype=jnp.bfloat16)
+    b = jax.random.normal(kb, (k, n), dtype=jnp.bfloat16)
+    c = jax.random.normal(kc, (n, k), dtype=jnp.bfloat16)
 
     def make_chain(length: int):
         @jax.jit

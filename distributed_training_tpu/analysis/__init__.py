@@ -1,0 +1,22 @@
+"""Static analysis: compile-time SPMD auditing + JAX-pitfall linting.
+
+Two halves, one gate (``python -m distributed_training_tpu.analysis
+--check``, wired into tier-1 via tests/test_lint_local.py):
+
+- ``audit.py`` / ``targets.py`` / ``compile.py`` / ``baseline.py``:
+  lower + compile each named config × strategy abstractly on a
+  simulated mesh, flag involuntary-reshard cliffs, unattributed
+  collectives, and replicated large params; ratchet against the
+  committed ``spmd_baseline.json`` so only NEW findings fail.
+- ``pitfalls.py``: the DTT00x AST rule registry (host syncs in the
+  step loop, host-local collective guards, PRNG key reuse, undonated
+  train steps, ...), shared with ``tools/lint_local.py``.
+
+Rule catalog and workflows: docs/static-analysis.md.
+"""
+
+from distributed_training_tpu.analysis import (  # noqa: F401
+    baseline,
+    pitfalls,
+    targets,
+)

@@ -1,0 +1,129 @@
+"""CLI: ``python -m distributed_training_tpu.analysis [--check]``.
+
+Runs the JAX-pitfall rules (DTT00x) over the repo and the SPMD audit
+over every named target, writes ``spmd_audit.json`` (``schema: 1``),
+prints the human report, and — under ``--check`` — exits nonzero on
+any rule violation or any audit finding NOT in the committed baseline
+(the ratchet). ``--write-baseline`` freezes the current findings as
+the new known set.
+
+Platform env (CPU backend, enough fake devices for the largest
+target) is forced at import time, BEFORE any jax backend initializes:
+the audits are device-less by design and must not touch — or depend
+on the health of — a real accelerator.
+"""
+
+from __future__ import annotations
+
+import os as _os
+
+# Must precede the first jax backend initialization (package import
+# does not initialize a backend; the first devices() call does).
+_os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = _os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    _os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import argparse  # noqa: E402
+import json      # noqa: E402
+import os        # noqa: E402
+import sys       # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def run_rules(repo: str = REPO) -> list[str]:
+    """DTT00x pitfall rules over every repo file (tests exempt; walk
+    and skip set shared with tools/lint_local.py via pitfalls)."""
+    from distributed_training_tpu.analysis import pitfalls
+    problems: list[str] = []
+    for path in pitfalls.iter_py_files(repo):
+        problems += pitfalls.check_file_rules(path, repo=repo)
+    return problems
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m distributed_training_tpu.analysis",
+        description="Static SPMD audit + JAX-pitfall lint gate.")
+    ap.add_argument("--check", action="store_true",
+                    help="exit 1 on any rule violation or any audit "
+                         "finding not in the baseline")
+    ap.add_argument("--targets", default="",
+                    help="comma-separated audit target names "
+                         "(default: all)")
+    ap.add_argument("--json", default=None, metavar="PATH",
+                    help="where to write spmd_audit.json (default "
+                         "outputs/analysis/spmd_audit.json; '-' to "
+                         "skip)")
+    ap.add_argument("--baseline", default=None, metavar="PATH",
+                    help="baseline file (default: the committed "
+                         "analysis/spmd_baseline.json)")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="freeze current audit findings as the new "
+                         "baseline")
+    ap.add_argument("--min-replicated-mib", type=float, default=1.0,
+                    help="SPMD003 size floor in MiB (default 1)")
+    ap.add_argument("--no-audit", action="store_true",
+                    help="rules only (no compiles)")
+    ap.add_argument("--no-rules", action="store_true",
+                    help="audit only")
+    args = ap.parse_args(argv)
+
+    rc = 0
+    if not args.no_rules:
+        problems = run_rules()
+        for p in problems:
+            print(p)
+        print(f"[analysis] rules: {len(problems)} violation(s)")
+        if problems:
+            rc = 1
+
+    if not args.no_audit:
+        from distributed_training_tpu.analysis import (audit,
+                                                       baseline)
+        names = [n for n in args.targets.split(",") if n] or None
+        if args.write_baseline and names:
+            # A subset run must never rewrite the committed baseline:
+            # write() replaces it wholesale, so the unselected
+            # targets' known findings would vanish and the next full
+            # --check would report them all as NEW.
+            ap.error("--write-baseline requires a full run "
+                     "(drop --targets)")
+        doc = audit.audit_targets(
+            names,
+            min_replicated_bytes=int(
+                args.min_replicated_mib * 2**20))
+        if args.write_baseline:
+            path = baseline.write(audit.all_findings(doc),
+                                  path=args.baseline)
+            print(f"[analysis] baseline written: {path} "
+                  f"({doc['totals']['findings']} finding(s))")
+        cmp = baseline.compare(audit.all_findings(doc),
+                               baseline.load(args.baseline),
+                               targets=names)
+        for line in audit.render_report(doc, cmp):
+            print(line)
+        json_path = args.json or os.path.join(
+            "outputs", "analysis", "spmd_audit.json")
+        if json_path != "-":
+            if os.path.dirname(json_path):
+                os.makedirs(os.path.dirname(json_path), exist_ok=True)
+            with open(json_path, "w", encoding="utf-8") as f:
+                json.dump(doc, f, indent=1, sort_keys=True)
+                f.write("\n")
+            print(f"[analysis] audit written: {json_path}")
+        if cmp["new"] and not args.write_baseline:
+            print(f"[analysis] {len(cmp['new'])} NEW audit "
+                  "finding(s) not in baseline")
+            rc = 1
+
+    if not args.check:
+        return 0
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,248 @@
+"""Compile-time SPMD auditor: static findings over the compiled step.
+
+For each named target (``targets.py``) this lowers + compiles the real
+jitted train step abstractly on a simulated mesh (``compile.py``),
+captures XLA's SPMD-partitioner diagnostics from the stderr fd, and
+derives three finding classes from artifacts the run itself produces:
+
+- **SPMD001 involuntary reshard**: the partitioner's "Involuntary full
+  rematerialization" warning — to move a tensor between two shardings
+  it replicates the FULL tensor on every device and re-partitions.
+  Silent at small scale, a cliff at pod scale (traffic scales with the
+  tensor, not the shard). Parsed by
+  ``telemetry/collectives.py::parse_reshard_warnings`` — the same
+  parser the trainer's ``collectives`` event uses, so the gate and the
+  ledger can never disagree about the count.
+- **SPMD002 unattributed collective**: a collective whose
+  ``replica_groups`` match no grouping any combination of declared
+  mesh axes can produce (``mesh_axis_groupings``). Either the layout
+  sprouted communication nobody designed, or the mesh declaration no
+  longer describes the program — both are findings.
+- **SPMD003 replicated large parameter**: under a model-sharded
+  strategy (fsdp/tp > 1), a parameter above the size floor whose
+  sharding spec references no mesh axis — it costs full-size HBM on
+  every device (cross-checked against ``utils/memory.py``'s exact
+  per-device residency accounting).
+
+Findings carry stable fingerprints; ``baseline.py`` ratchets them so
+CI fails only on NEW findings while the committed known set burns
+down. Everything here is static — no training state is ever
+materialized, no accelerator is needed.
+"""
+
+from __future__ import annotations
+
+from distributed_training_tpu.analysis import baseline as baseline_lib
+from distributed_training_tpu.analysis import targets as targets_lib
+from distributed_training_tpu.analysis.compile import (
+    build_abstract_trainer)
+
+SCHEMA = 1
+
+CODES = {
+    "SPMD001": "involuntary full rematerialization (reshard cliff)",
+    "SPMD002": "collective matches no declared mesh-axis grouping",
+    "SPMD003": "large parameter fully replicated under a sharded "
+               "strategy",
+}
+
+
+def _finding(code: str, target: str, fingerprint: str, message: str,
+             **detail) -> dict:
+    return {"code": code, "target": target,
+            "fingerprint": fingerprint, "message": message,
+            "detail": detail}
+
+
+def _reshard_findings(target, warnings: list[dict]) -> list[dict]:
+    out, seen = [], set()
+    for w in warnings:
+        fp = (f"SPMD001:{target.name}:{w['op']}:"
+              f"{w['dtype']}[{w['shape']}]:"
+              f"{w['from_sharding']}->{w['to_sharding']}")
+        if fp in seen:
+            continue
+        seen.add(fp)
+        out.append(_finding(
+            "SPMD001", target.name, fp,
+            f"involuntary full rematerialization at %{w['op']} "
+            f"{w['dtype']}[{w['shape']}] "
+            f"({w['from_sharding'] or '?'} -> "
+            f"{w['to_sharding'] or '?'})",
+            op=w["op"], dtype=w["dtype"], shape=w["shape"],
+            from_sharding=w["from_sharding"],
+            to_sharding=w["to_sharding"]))
+    return out
+
+
+def _unattributed_findings(target, coll_report: dict) -> list[dict]:
+    """SPMD002 rows: collectives whose replica groups matched no
+    mesh-axis grouping. Fingerprinted by kind+type (not count): the
+    ratchet catches new SHAPES of unattributed traffic; magnitude
+    drift is the comms-roofline telemetry's job."""
+    rows = [r for r in coll_report.get("rows", [])
+            if r.get("axes") == "unknown"]
+    by_fp: dict[str, dict] = {}
+    for r in rows:
+        fp = (f"SPMD002:{target.name}:{r['kind']}:"
+              f"{r['dtype']}[{r['shape']}]")
+        if fp in by_fp:
+            by_fp[fp]["detail"]["count"] += 1
+            continue
+        by_fp[fp] = _finding(
+            "SPMD002", target.name, fp,
+            f"{r['kind']} {r['dtype']}[{r['shape']}] communicates "
+            "over replica groups matching no declared mesh-axis "
+            "grouping",
+            kind=r["kind"], dtype=r["dtype"], shape=r["shape"],
+            bytes=r["bytes"], count=1)
+    return list(by_fp.values())
+
+
+def _replicated_param_findings(target, trainer,
+                               min_bytes: int) -> list[dict]:
+    import jax
+    import numpy as np
+
+    from distributed_training_tpu.utils.memory import (
+        state_bytes_per_device)
+
+    sizes = trainer.rt.spec.as_dict()
+    model_shards = sizes.get("fsdp", 1) * sizes.get("tp", 1)
+    if model_shards <= 1:
+        return []  # nothing claims to shard the model; rule is moot
+    param_shapes = jax.eval_shape(trainer.model.init, trainer.init_rng)
+    shardings = trainer.state_shardings["params"]
+    per_device = max(1, state_bytes_per_device(param_shapes, shardings))
+    out: list[dict] = []
+
+    def leaf(path, shape, sh):
+        name = "/".join(str(getattr(k, "key", getattr(k, "idx", k)))
+                        for k in path)
+        nbytes = int(np.prod(shape.shape)) * \
+            np.dtype(shape.dtype).itemsize
+        spec = getattr(sh, "spec", None)
+        sharded = spec is not None and any(p is not None for p in spec)
+        if sharded or nbytes < min_bytes:
+            return
+        fp = f"SPMD003:{target.name}:{name}"
+        out.append(_finding(
+            "SPMD003", target.name, fp,
+            f"param {name} ({nbytes / 2**20:.1f} MiB) fully "
+            f"replicated under a {model_shards}x model-sharded mesh "
+            f"— {100 * nbytes / per_device:.0f}% of per-device param "
+            "residency (utils/memory.py accounting)",
+            param=name, bytes=nbytes,
+            per_device_param_bytes=per_device,
+            mesh={a: s for a, s in sizes.items() if s > 1}))
+
+    jax.tree_util.tree_map_with_path(leaf, param_shapes, shardings)
+    return sorted(out, key=lambda f: -f["detail"]["bytes"])
+
+
+def audit_target(target, min_replicated_bytes: int = 1 << 20) -> dict:
+    """Compile one target and return its audit record (findings +
+    collective summary + reshard-warning count)."""
+    import jax.numpy as jnp
+
+    from distributed_training_tpu.telemetry import collectives
+
+    trainer, rt, batch = build_abstract_trainer(
+        target.devices, target.strategy, target.model,
+        dict(target.model_kwargs), target.batch_size, target.seq_len,
+        mesh_axes=dict(target.mesh_axes),
+        train_overrides=dict(target.train_overrides))
+    with collectives.capture_stderr_fd() as cap:
+        text = trainer._step_fn.lower(
+            trainer.state, batch,
+            jnp.zeros((2,), jnp.uint32)).compile().as_text()
+    warnings = collectives.parse_reshard_warnings(cap.text)
+    coll = collectives.audit_hlo_text(text, mesh=rt.mesh)
+    coll["mesh"] = {a: s for a, s in rt.spec.as_dict().items()
+                    if s > 1}
+    coll["spmd_reshard_warnings"] = len(warnings)
+
+    findings = (_reshard_findings(target, warnings)
+                + _unattributed_findings(target, coll)
+                + _replicated_param_findings(
+                    target, trainer, min_replicated_bytes))
+    by_code: dict[str, int] = {}
+    for f in findings:
+        by_code[f["code"]] = by_code.get(f["code"], 0) + 1
+    return {
+        "target": target.name,
+        "title": target.title,
+        "devices": target.devices,
+        "strategy": target.strategy,
+        "mesh": coll["mesh"],
+        "spmd_reshard_warnings": len(warnings),
+        "findings": findings,
+        "findings_by_code": by_code,
+        "collectives": collectives.summary_of_event(coll),
+    }
+
+
+def audit_targets(names=None,
+                  min_replicated_bytes: int = 1 << 20) -> dict:
+    """The full ``spmd_audit.json`` document (``schema: 1``)."""
+    return assemble_doc([audit_target(t, min_replicated_bytes)
+                         for t in targets_lib.resolve(names)])
+
+
+def assemble_doc(records: list[dict]) -> dict:
+    """Wrap per-target audit records into the spmd_audit.json shape
+    (split from audit_targets so callers holding records — tests,
+    cached runs — assemble without recompiling)."""
+    by_code: dict[str, int] = {}
+    for r in records:
+        for c, n in r["findings_by_code"].items():
+            by_code[c] = by_code.get(c, 0) + n
+    return {
+        "schema": SCHEMA,
+        "codes": CODES,
+        "targets": records,
+        "totals": {
+            "targets": len(records),
+            "findings": sum(len(r["findings"]) for r in records),
+            "by_code": by_code,
+        },
+    }
+
+
+def all_findings(audit_doc: dict) -> list[dict]:
+    return [f for r in audit_doc["targets"] for f in r["findings"]]
+
+
+def render_report(audit_doc: dict, cmp: dict | None = None
+                  ) -> list[str]:
+    """Human report lines. With ``cmp`` (``baseline.compare`` output)
+    each finding is tagged [known]/[NEW] and stale baseline entries
+    are listed for burn-down."""
+    from distributed_training_tpu.telemetry import collectives
+
+    new_fps = set(f["fingerprint"] for f in cmp["new"]) if cmp else set()
+    lines: list[str] = []
+    for r in audit_doc["targets"]:
+        mesh = ",".join(f"{a}={s}" for a, s in r["mesh"].items()) \
+            or "single-device"
+        lines.append(f"== {r['target']}: {r['title']}")
+        lines.append(f"   devices={r['devices']} strategy="
+                     f"{r['strategy']} mesh={mesh}")
+        for line in collectives.render_lines(r["collectives"]):
+            lines.append("   " + line)
+        if not r["findings"]:
+            lines.append("   findings: none")
+        for f in r["findings"]:
+            tag = ""
+            if cmp:
+                tag = "[NEW] " if f["fingerprint"] in new_fps \
+                    else "[known] "
+            lines.append(f"   {f['code']} {tag}{f['message']}")
+    if cmp:
+        lines.append(
+            f"baseline: {len(cmp['known'])} known, "
+            f"{len(cmp['new'])} NEW, {len(cmp['stale'])} stale")
+        for fp in cmp["stale"]:
+            lines.append(f"   stale baseline entry (fixed? tighten "
+                         f"{baseline_lib.DEFAULT_PATH}): {fp}")
+    return lines

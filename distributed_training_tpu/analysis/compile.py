@@ -1,0 +1,129 @@
+"""Abstract lowering/compilation of the REAL train step, chip-free.
+
+The one shared implementation of "build the actual Trainer against a
+simulated mesh and compile its jitted step without materializing any
+state" — the device-less discipline ``Trainer.collectives_report``
+uses. Three consumers ride it so their trainer/batch construction can
+never drift apart:
+
+- the SPMD auditor (``analysis/audit.py``): compiles every named
+  target and inspects diagnostics + HLO;
+- ``benchmarks/audit_collectives.py``: the CLI wrapper (kept for its
+  UX; thin re-export of these helpers);
+- ``benchmarks/precompile_points.py``: warms the compile cache through
+  ``lower_abstract_step``.
+
+Simulated meshes come in two flavors: CPU fake devices
+(``--xla_force_host_platform_device_count``, compiles with the CPU
+partitioner) and device-less TPU topology descriptors
+(``jax.experimental.topologies`` — the real libtpu pipeline, whose
+passes differ: reduce-scatter-creator etc.). jax is imported inside
+the functions, never at module top: callers (CLI entrypoints) must be
+able to set platform env vars first.
+"""
+
+from __future__ import annotations
+
+
+def build_abstract_trainer(n_devices: int, strategy: str,
+                           model_name: str, model_kwargs: dict,
+                           batch_size: int, seq_len: int,
+                           mesh_axes: dict | None = None,
+                           train_overrides: dict | None = None,
+                           tpu_topology: str | None = None):
+    """The REAL Trainer in abstract mode on a simulated mesh.
+
+    Returns ``(trainer, runtime, batch)`` where ``batch`` is a
+    ShapeDtypeStruct tree carrying the trainer's batch sharding —
+    ready for ``trainer._step_fn.lower(trainer.state, batch, rng)``.
+    Nothing is materialized: shardings, the jitted step, and the
+    strategy all exist, but ``trainer.state`` is abstract, so this
+    also works on meshes with no attached devices (``tpu_topology``,
+    e.g. "v5e:2x2").
+    """
+    import jax
+    import numpy as np
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from distributed_training_tpu.config import Config
+    from distributed_training_tpu.data import (ShardedDataLoader,
+                                               SyntheticLMDataset)
+    from distributed_training_tpu.models import build_model
+    from distributed_training_tpu.runtime import (fake_cpu_runtime,
+                                                  topology_runtime)
+    from distributed_training_tpu.train.trainer import Trainer
+
+    cfg = Config()
+    cfg.train.parallel_strategy = strategy
+    cfg.train.batch_size = batch_size
+    cfg.train.log_every = 0
+    for k, v in (train_overrides or {}).items():
+        setattr(cfg.train, k, v)
+    if tpu_topology:
+        rt = topology_runtime(n_devices, tpu_topology,
+                              **(mesh_axes or {}))
+    else:
+        rt = fake_cpu_runtime(n_devices, **(mesh_axes or {}))
+    model = build_model(model_name, **model_kwargs)
+    ds = SyntheticLMDataset(
+        size=max(64, batch_size),
+        seq_len=seq_len,
+        vocab_size=min(model.cfg.vocab_size, 50257), seed=0)
+    loader = ShardedDataLoader(ds, rt, batch_size=batch_size,
+                               shuffle=False)
+    trainer = Trainer(cfg, rt, model, loader, abstract=True)
+    sample = ds.batch(np.arange(1))
+    batch = {
+        k: jax.ShapeDtypeStruct(
+            (loader.global_batch,) + v.shape[1:], v.dtype,
+            sharding=trainer.batch_sharding)
+        for k, v in sample.items()}
+    return trainer, rt, batch
+
+
+def lower_abstract_step(topology: str, n_devices: int, strategy: str,
+                        model_name: str, model_kwargs: dict,
+                        batch_size: int, seq_len: int,
+                        mesh_axes: dict | None = None,
+                        train_overrides: dict | None = None):
+    """Build the abstract Trainer against a DEVICE-LESS TPU topology
+    and return the Lowered train step (zero materialized state)."""
+    import jax.numpy as jnp
+
+    trainer, _rt, batch = build_abstract_trainer(
+        n_devices, strategy, model_name, model_kwargs, batch_size,
+        seq_len, mesh_axes=mesh_axes, train_overrides=train_overrides,
+        tpu_topology=topology)
+    return trainer._step_fn.lower(trainer.state, batch,
+                                  jnp.zeros((2,), jnp.uint32))
+
+
+def compile_step_hlo(n_devices: int, strategy: str,
+                     mesh_axes: dict | None = None,
+                     model_kwargs: dict | None = None,
+                     tpu_topology: str | None = None,
+                     seq_len: int = 32) -> str:
+    """Build the real Trainer on a virtual mesh and return the
+    compiled (SPMD-partitioned) HLO of its jitted train step.
+
+    ``tpu_topology`` (e.g. "v5e:2x2") compiles with the REAL TPU
+    compiler against a device-less topology descriptor instead of the
+    CPU backend — the partitioning passes differ (the TPU pipeline
+    runs reduce-scatter-creator; CPU lowers FSDP grad sync as
+    all-reduce + dynamic-slice), so contract claims about what runs
+    on hardware must audit this path (VERDICT r4 item 4)."""
+    import jax.numpy as jnp
+
+    mk = dict(vocab_size=256, d_model=64, n_layers=2, n_heads=4,
+              max_seq_len=64, dtype="float32")
+    mk.update(model_kwargs or {})
+    trainer, _rt, batch = build_abstract_trainer(
+        n_devices, strategy, "transformer", mk,
+        batch_size=2 * n_devices, seq_len=seq_len,
+        mesh_axes=mesh_axes,
+        train_overrides=dict(min_shard_elems=1, dtype="float32"),
+        tpu_topology=tpu_topology)
+    return trainer._step_fn.lower(
+        trainer.state, batch,
+        jnp.zeros((2,), jnp.uint32)).compile().as_text()

@@ -1,0 +1,527 @@
+"""AST-level JAX-pitfall lint rules (DTT00x) as a registry.
+
+Each rule encodes a discipline the codebase otherwise keeps only by
+convention — and conventions are exactly what the next contributor
+breaks. The registry form exists so the repo's two gates cannot drift:
+``tools/lint_local.py`` (the flake8-parity gate wired into tier-1) and
+``python -m distributed_training_tpu.analysis --check`` (the static-
+analysis CLI) both run THIS table, not private copies.
+
+IMPORT CONTRACT: stdlib only. ``tools/lint_local.py`` loads this file
+by path (``importlib``) precisely so linting never imports the package
+``__init__`` — which imports jax — and the lint gate stays fast and
+runnable on a machine with a broken accelerator stack. Do not import
+jax, numpy, or anything from ``distributed_training_tpu`` here.
+
+Suppression uses flake8 ``# noqa`` scoping: a bare ``# noqa`` on the
+flagged line suppresses everything, ``# noqa: DTT003`` only that rule.
+``tests/`` is exempt from every rule in this module (fixtures
+deliberately write bad patterns; test jit steps reuse buffers).
+
+Rule catalog (details in docs/static-analysis.md):
+
+- DTT001 bare jsonl emission outside the telemetry sink.
+- DTT002 silent broad exception swallow.
+- DTT003 host sync in the hot step path: ``.item()``, ``float(arr)``,
+  ``jax.device_get``, ``block_until_ready`` inside the trainer's step
+  loop defeat async dispatch — one blocked host stalls every chip.
+- DTT004 collective-cadence divergence: a cross-host collective
+  lexically guarded by a host-local condition (``is_coordinator``,
+  wall-clock, ...) deadlocks the pod — the discipline
+  ``telemetry/straggler.py`` and ``resilience/faults.py`` follow
+  (cadence = pure function of ``global_step``), now enforced.
+- DTT005 PRNG key reuse: the same key consumed twice without
+  ``jax.random.split``/``fold_in`` silently repeats randomness.
+- DTT006 jitted train-step without buffer donation: params/opt-state
+  double-buffer in HBM, halving the usable memory budget.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass
+from typing import Callable
+
+# Repo root when this file sits at <repo>/distributed_training_tpu/
+# analysis/pitfalls.py; callers may override per-call.
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+# Directories never linted/audited (generated artifacts, caches,
+# postmortem evidence). ONE copy, used by both gates
+# (tools/lint_local.py and the analysis CLI) so they can never walk
+# different file sets.
+SKIP_DIRS = {".git", "__pycache__", "outputs", "_build", ".venv",
+             "state", "evidence", "postmortem"}
+
+
+def iter_py_files(root: str | None = None):
+    """Every lintable .py file under ``root`` (default: repo root)."""
+    for dirpath, dirnames, filenames in os.walk(root or REPO):
+        dirnames[:] = [d for d in dirnames if d not in SKIP_DIRS]
+        for name in filenames:
+            if name.endswith(".py"):
+                yield os.path.join(dirpath, name)
+
+# ---------------------------------------------------------------------------
+# Registry plumbing
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Rule:
+    code: str
+    name: str
+    summary: str
+    check: Callable
+
+
+RULES: dict[str, Rule] = {}
+
+
+def _rule(code: str, name: str, summary: str):
+    def deco(fn):
+        RULES[code] = Rule(code, name, summary, fn)
+        return fn
+    return deco
+
+
+class FileContext:
+    """One parsed file, shared across rules (parse once, lint many)."""
+
+    def __init__(self, path: str, rel: str, text: str,
+                 tree: ast.AST | None = None):
+        self.path = path
+        self.rel = rel
+        self.text = text
+        self.lines = text.splitlines()
+        self.tree = tree if tree is not None else ast.parse(text)
+        self._parents: dict | None = None
+
+    @property
+    def parents(self) -> dict:
+        if self._parents is None:
+            self._parents = {
+                child: parent
+                for parent in ast.walk(self.tree)
+                for child in ast.iter_child_nodes(parent)}
+        return self._parents
+
+    def ancestors(self, node):
+        while node in self.parents:
+            node = self.parents[node]
+            yield node
+
+
+def noqa_allows(lines: list[str], lineno: int, code: str) -> bool:
+    """flake8 noqa scoping: a bare ``# noqa`` suppresses everything,
+    ``# noqa: CODE[,CODE]`` only the named codes."""
+    if not (0 < lineno <= len(lines)):
+        return False
+    m = re.search(r"#\s*noqa(?::\s*([A-Z0-9, ]+))?", lines[lineno - 1])
+    return bool(m and (m.group(1) is None or code in m.group(1)))
+
+
+def check_file_rules(path: str, repo: str | None = None,
+                     text: str | None = None,
+                     tree: ast.AST | None = None) -> list[str]:
+    """Run every registered rule over one file; returns formatted
+    ``rel:line: CODE message`` problems (noqa-filtered). Files under
+    ``tests/`` are exempt wholesale; syntax errors yield no findings
+    (the caller's flake8 pass owns E999)."""
+    repo = repo or REPO
+    rel = os.path.relpath(path, repo)
+    if rel.startswith("tests" + os.sep):
+        return []
+    if text is None:
+        with open(path, encoding="utf-8") as f:
+            text = f.read()
+    try:
+        ctx = FileContext(path, rel, text, tree)
+    except SyntaxError:
+        return []
+    problems: list[str] = []
+    for code in sorted(RULES):
+        for lineno, msg in RULES[code].check(ctx):
+            if noqa_allows(ctx.lines, lineno, code):
+                continue
+            problems.append(f"{rel}:{lineno}: {code} {msg}")
+    return problems
+
+
+def _terminal_name(node) -> str:
+    """The rightmost identifier of a Name/Attribute chain ('' else)."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return ""
+
+
+def _attr_chain(node) -> list[str]:
+    """['jax', 'random', 'normal'] for ``jax.random.normal`` (best
+    effort; empty when the chain roots in a call/subscript)."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return list(reversed(parts))
+    return []
+
+
+def _names_in(node) -> set[str]:
+    """Every Name id and Attribute attr in a subtree."""
+    out: set[str] = set()
+    for n in ast.walk(node):
+        if isinstance(n, ast.Name):
+            out.add(n.id)
+        elif isinstance(n, ast.Attribute):
+            out.add(n.attr)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# DTT001 — bare jsonl emission
+# ---------------------------------------------------------------------------
+
+# The only modules allowed to open a jsonl stream for writing: the
+# event sink (host tagging lives there) and the metrics logger (its
+# own sink, predating telemetry; metrics.jsonl is not an event
+# stream). Everything else must emit through telemetry/events.py.
+JSONL_SINKS = {
+    os.path.join("distributed_training_tpu", "telemetry", "events.py"),
+    os.path.join("distributed_training_tpu", "utils", "metrics.py"),
+}
+_WRITE_CHARS = set("wax+")
+
+
+@_rule("DTT001", "bare-jsonl-write",
+       "write-mode open() of a *jsonl* stream outside the event sink")
+def _check_jsonl_sink(ctx: FileContext):
+    """A write-mode ``open`` of a ``*jsonl*`` stream outside the
+    telemetry/metrics sinks skips host tagging, and the multi-host
+    aggregator silently mis-attributes the records."""
+    if ctx.rel in JSONL_SINKS:
+        return
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "open" and node.args):
+            continue
+        mode = node.args[1] if len(node.args) >= 2 else None
+        for kw in node.keywords:
+            if kw.arg == "mode":
+                mode = kw.value
+        if not (isinstance(mode, ast.Constant)
+                and isinstance(mode.value, str)
+                and set(mode.value) & _WRITE_CHARS):
+            continue
+        target = ast.get_source_segment(ctx.text, node.args[0]) or ""
+        if "jsonl" not in target.lower():
+            continue
+        yield (node.lineno,
+               "write-mode open() of a jsonl stream outside the "
+               "telemetry sink — emit through telemetry/events.py "
+               "(host tagging)")
+
+
+# ---------------------------------------------------------------------------
+# DTT002 — silent broad exception swallow
+# ---------------------------------------------------------------------------
+
+# Files allowed to contain broad `except ...: pass` swallows.
+# Deliberately empty — every current swallow either logs a breadcrumb
+# or carries an inline `# noqa: DTT002` with its justification; add a
+# path here only when a whole file is best-effort by design.
+DTT002_ALLOWLIST: set[str] = set()
+_BROAD_EXC_NAMES = {"Exception", "BaseException"}
+
+
+@_rule("DTT002", "silent-broad-swallow",
+       "broad `except ...: pass` discards failure evidence")
+def _check_silent_swallow(ctx: FileContext):
+    """``except Exception: pass`` (or bare except / BaseException)
+    discards failure evidence — in a codebase whose failure model is
+    crash-restart-resume, that is how recovery bugs hide. Narrow
+    handlers (``except FileNotFoundError: pass``) are fine — naming
+    the exception is the evidence the swallow was a decision."""
+    if ctx.rel in DTT002_ALLOWLIST:
+        return
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if not all(isinstance(s, ast.Pass) for s in node.body):
+            continue
+        t = node.type
+        names = []
+        if t is None:
+            names = ["<bare>"]
+        elif isinstance(t, ast.Name):
+            names = [t.id]
+        elif isinstance(t, ast.Tuple):
+            names = [e.id for e in t.elts if isinstance(e, ast.Name)]
+        if not any(n == "<bare>" or n in _BROAD_EXC_NAMES
+                   for n in names):
+            continue
+        yield (node.lineno,
+               "silent broad exception swallow (`except Exception: "
+               "pass`) — narrow it, log a breadcrumb, or noqa with "
+               "justification")
+
+
+# ---------------------------------------------------------------------------
+# DTT003 — host sync in the hot step path
+# ---------------------------------------------------------------------------
+
+# Functions that ARE the hot step path, per file. The trainer's step
+# loop is the one place a host sync stalls every chip in the mesh (the
+# dispatch queue drains and the devices idle until the host catches
+# up). Deliberate once-per-epoch/eval syncs carry `# noqa: DTT003`
+# with their justification — the noqa is the documentation.
+DTT003_HOT_PATHS: dict[str, set[str]] = {
+    os.path.join("distributed_training_tpu", "train", "trainer.py"):
+        {"train_step", "_run_epoch", "evaluate"},
+}
+_HOST_SYNC_ATTRS = {"item", "block_until_ready", "device_get"}
+_HOST_SYNC_CASTS = {"float", "int", "bool"}
+
+
+@_rule("DTT003", "hot-path-host-sync",
+       "host-device sync inside the hot step path")
+def _check_hot_path_sync(ctx: FileContext):
+    """``.item()`` / ``float(arr)`` / ``jax.device_get`` /
+    ``block_until_ready`` inside the trainer's step loop force a
+    per-step host round-trip, defeating async dispatch (the repo's
+    design is ONE host sync per epoch). Casts of constants are fine."""
+    hot = DTT003_HOT_PATHS.get(ctx.rel)
+    if not hot:
+        return
+    for fn in ast.walk(ctx.tree):
+        if not (isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and fn.name in hot):
+            continue
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _terminal_name(node.func)
+            if name in _HOST_SYNC_ATTRS:
+                yield (node.lineno,
+                       f"host sync `{name}()` in hot step path "
+                       f"`{fn.name}` — keep device values on device "
+                       "(one sync per epoch; noqa deliberate syncs)")
+            elif (isinstance(node.func, ast.Name)
+                  and name in _HOST_SYNC_CASTS and node.args
+                  and not all(isinstance(a, ast.Constant)
+                              for a in node.args)):
+                yield (node.lineno,
+                       f"host sync `{name}(...)` in hot step path "
+                       f"`{fn.name}` — keep device values on device "
+                       "(one sync per epoch; noqa deliberate syncs)")
+
+
+# ---------------------------------------------------------------------------
+# DTT004 — collective cadence must not be host-local
+# ---------------------------------------------------------------------------
+
+# Host-level collectives (left) must be reached by EVERY host at the
+# same loop point; any lexically-enclosing condition that can evaluate
+# differently per host (right) strands the others in the collective.
+_DTT004_COLLECTIVES = {
+    "process_allgather", "sync_global_devices", "broadcast_one_to_all",
+    "assert_equal", "psum", "pmean", "pmax", "pmin", "all_gather",
+    "all_to_all", "ppermute",
+}
+_DTT004_HOST_LOCAL = {
+    "is_coordinator", "process_index", "should_stop", "perf_counter",
+    "monotonic", "time", "time_ns", "random", "getrandbits", "uuid4",
+    "environ", "getenv", "gethostname",
+}
+
+
+@_rule("DTT004", "host-local-collective-guard",
+       "collective reachable under a host-local condition")
+def _check_collective_cadence(ctx: FileContext):
+    """A ``process_allgather``/``psum``/... guarded by a condition
+    that differs across hosts (coordinator checks, wall-clock, env)
+    deadlocks the pod: some hosts enter the collective, the rest never
+    arrive. Cadence must be a pure function of ``global_step`` or of
+    config identical on every host (the straggler/faults discipline).
+    Lexical check only — early-return guards are invisible to it."""
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Call)
+                and _terminal_name(node.func) in _DTT004_COLLECTIVES):
+            continue
+        for anc in ctx.ancestors(node):
+            if not isinstance(anc, (ast.If, ast.While, ast.IfExp)):
+                continue
+            markers = _names_in(anc.test) & _DTT004_HOST_LOCAL
+            if markers:
+                yield (node.lineno,
+                       f"collective `{_terminal_name(node.func)}` "
+                       "reachable under host-local condition "
+                       f"({', '.join(sorted(markers))}) — cadence "
+                       "must be a pure function of global_step "
+                       "(deadlock risk)")
+                break
+
+
+# ---------------------------------------------------------------------------
+# DTT005 — PRNG key reuse
+# ---------------------------------------------------------------------------
+
+_KEY_MAKERS = {"PRNGKey", "key", "split", "fold_in"}
+_KEY_NONCONSUMERS = _KEY_MAKERS | {"wrap_key_data", "key_data",
+                                   "clone"}
+
+
+def _dtt005_scope_events(scope, skip_nested: bool = True):
+    """(lineno, col, kind, name) events for one function/module scope:
+    'make' = a name bound from PRNGKey/split/fold_in OR received as a
+    function parameter (keys threaded in as arguments are the common
+    real reuse pattern), 'bind' = any other rebind of a name, 'use' =
+    the name in the KEY position of a ``jax.random.*`` sampler call
+    (first positional arg, or a key/rng/seed kwarg — never shape/count
+    args, so tracking every parameter cannot false-positive on them).
+    """
+    events = []
+    tracked: set[str] = set()
+    if isinstance(scope, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        a = scope.args
+        for arg in (a.posonlyargs + a.args + a.kwonlyargs
+                    + ([a.vararg] if a.vararg else [])
+                    + ([a.kwarg] if a.kwarg else [])):
+            tracked.add(arg.arg)
+            events.append((scope.lineno, -1, "make", arg.arg))
+
+    def visit(node, top=False):
+        if not top and skip_nested and isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                       ast.Lambda)):
+            return
+        if isinstance(node, ast.Assign):
+            chain = (_attr_chain(node.value.func)
+                     if isinstance(node.value, ast.Call) else [])
+            is_key = bool(chain) and chain[-1] in _KEY_MAKERS and (
+                len(chain) == 1 or "random" in chain)
+            for t in node.targets:
+                names = (t.elts if isinstance(t, ast.Tuple) else [t])
+                for el in names:
+                    if isinstance(el, ast.Name):
+                        kind = "make" if is_key else "bind"
+                        if kind == "make":
+                            tracked.add(el.id)
+                        events.append((node.lineno, node.col_offset,
+                                       kind, el.id))
+        if isinstance(node, ast.Call):
+            chain = _attr_chain(node.func)
+            if (len(chain) >= 2 and chain[-2] == "random"
+                    and chain[-1] not in _KEY_NONCONSUMERS):
+                for arg in node.args[:1] + [
+                        kw.value for kw in node.keywords
+                        if kw.arg in ("key", "rng", "seed")]:
+                    if isinstance(arg, ast.Name):
+                        events.append((arg.lineno, arg.col_offset,
+                                       "use", arg.id))
+        for child in ast.iter_child_nodes(node):
+            visit(child)
+
+    visit(scope, top=True)
+    return [e for e in sorted(events) if e[3] in tracked]
+
+
+@_rule("DTT005", "prng-key-reuse",
+       "a PRNG key consumed twice without split/fold_in")
+def _check_key_reuse(ctx: FileContext):
+    """Passing the same key to two ``jax.random.*`` samplers yields
+    IDENTICAL randomness — correlated inits, repeated dropout masks.
+    Split (or fold_in) before every consumption. Lexical check per
+    scope: reuse across loop iterations is out of reach."""
+    scopes = [ctx.tree] + [
+        n for n in ast.walk(ctx.tree)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+    for scope in scopes:
+        counts: dict[str, int] = {}
+        for lineno, _col, kind, name in _dtt005_scope_events(scope):
+            if kind in ("make", "bind"):
+                counts[name] = 0
+            elif kind == "use":
+                counts[name] = counts.get(name, 0) + 1
+                if counts[name] == 2:
+                    yield (lineno,
+                           f"PRNG key `{name}` consumed again without "
+                           "jax.random.split/fold_in — identical "
+                           "randomness at both sites")
+
+
+# ---------------------------------------------------------------------------
+# DTT006 — jitted train step must donate its buffers
+# ---------------------------------------------------------------------------
+
+_STEP_NAME = re.compile(r"(^|_)(train_?)?step(_?fn)?$", re.IGNORECASE)
+
+
+def _dtt006_step_like(ctx: FileContext, call: ast.Call) -> str:
+    """Why this ``jax.jit`` call looks like a train step ('' if not):
+    the jitted function's name, or the assignment target's name,
+    matches the step pattern."""
+    if call.args:
+        arg = call.args[0]
+        name = _terminal_name(arg)
+        if not name and isinstance(arg, ast.Call):
+            name = _terminal_name(arg.func)
+        if name and _STEP_NAME.search(name):
+            return name
+    parent = ctx.parents.get(call)
+    if isinstance(parent, ast.Assign):
+        for t in parent.targets:
+            name = _terminal_name(t)
+            if name and _STEP_NAME.search(name):
+                return name
+    return ""
+
+
+def _donates(call: ast.Call) -> bool:
+    return any(kw.arg in ("donate_argnums", "donate_argnames")
+               for kw in call.keywords)
+
+
+@_rule("DTT006", "undonated-train-step",
+       "jitted train step without buffer donation")
+def _check_step_donation(ctx: FileContext):
+    """A jitted train step that does not donate params/opt-state
+    double-buffers the whole training state in HBM — the old buffers
+    stay live across the update. ``donate_argnums``/``donate_argnames``
+    is the contract (trainer.py donates argnum 0). Covers the call
+    form (``jax.jit(step)``), the bare decorator (``@jax.jit``), and
+    the partial decorator (``@partial(jax.jit, ...)``)."""
+    for node in ast.walk(ctx.tree):
+        if (isinstance(node, ast.Call)
+                and _terminal_name(node.func) == "jit"):
+            why = _dtt006_step_like(ctx, node)
+            if why and not _donates(node):
+                yield (node.lineno,
+                       f"jitted train step `{why}` without "
+                       "donate_argnums/donate_argnames — params/opt "
+                       "state double-buffer in HBM")
+        elif (isinstance(node, (ast.FunctionDef,
+                                ast.AsyncFunctionDef))
+              and _STEP_NAME.search(node.name)):
+            for dec in node.decorator_list:
+                bare_jit = _terminal_name(dec) == "jit"
+                call_jit = (isinstance(dec, ast.Call)
+                            and _terminal_name(dec.func) == "jit")
+                partial_jit = (
+                    isinstance(dec, ast.Call)
+                    and _terminal_name(dec.func) == "partial"
+                    and dec.args
+                    and _terminal_name(dec.args[0]) == "jit")
+                if bare_jit or ((call_jit or partial_jit)
+                                and not _donates(dec)):
+                    yield (dec.lineno,
+                           f"jitted train step `{node.name}` without "
+                           "donate_argnums/donate_argnames — params/"
+                           "opt state double-buffer in HBM")

@@ -1,0 +1,104 @@
+"""Named audit targets: config × strategy pairs the SPMD auditor gates.
+
+A target pins everything that determines the compiled program — mesh
+shape, strategy, model kwargs, batch/seq — so a finding's fingerprint
+is reproducible run-over-run and the committed baseline
+(``spmd_baseline.json``) stays meaningful. Add a target when a new
+config/strategy combination becomes a supported path; the ratchet
+then freezes its current findings and fails CI on any new one.
+
+The two seed targets mirror the repo's live evidence:
+
+- ``multichip_r05_tp_sp_fsdp``: the exact dryrun pass-1 configuration
+  from ``__graft_entry__.py`` (the one ``MULTICHIP_r05.json`` records
+  with two "Involuntary full rematerialization" warnings on the
+  gather/all-gather path) — the repro ROADMAP item 1's auto-planner
+  must drive to zero.
+- ``single_chip_headline``: the 0.4392-MFU gpt2_125m single-chip
+  headline configuration (bench.py HEADLINE_MODEL_KWARGS + the gpt2
+  train defaults). Audit-sized batch — findings are sharding
+  properties of the compiled program, not batch-magnitude properties
+  — and it must stay at ZERO findings.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+@dataclass(frozen=True)
+class AuditTarget:
+    name: str
+    title: str
+    devices: int
+    strategy: str
+    model: str
+    model_kwargs: dict = field(default_factory=dict)
+    batch_size: int = 4
+    seq_len: int = 32
+    mesh_axes: dict = field(default_factory=dict)
+    train_overrides: dict = field(default_factory=dict)
+    note: str = ""
+
+
+TARGETS: dict[str, AuditTarget] = {}
+
+
+def _register(t: AuditTarget) -> AuditTarget:
+    TARGETS[t.name] = t
+    return t
+
+
+_register(AuditTarget(
+    name="multichip_r05_tp_sp_fsdp",
+    title="8-device tp+sp+fsdp dryrun (windowed GQA ring attention)",
+    devices=8,
+    strategy="tp",
+    model="transformer",
+    model_kwargs=dict(vocab_size=256, d_model=64, n_heads=4,
+                      dtype="float32", max_seq_len=32, n_layers=2,
+                      n_kv_heads=2, attention_impl="ring",
+                      attention_window=24),
+    batch_size=2,
+    seq_len=32,
+    mesh_axes=dict(fsdp=2, sp=2, tp=2),
+    train_overrides=dict(min_shard_elems=1, dtype="float32",
+                         optimizer="adamw"),
+    note="__graft_entry__.py dryrun pass 1 — the MULTICHIP_r05.json "
+         "configuration whose SPMD log shows involuntary full "
+         "rematerialization on the gather/all-gather path. Known "
+         "findings are baselined; ROADMAP item 1's planner drives "
+         "them to zero.",
+))
+
+_register(AuditTarget(
+    name="single_chip_headline",
+    title="gpt2_125m single-chip headline (0.4392 MFU config)",
+    devices=1,
+    strategy="ddp",
+    model="gpt2_125m",
+    model_kwargs=dict(remat=True, remat_policy="mlp",
+                      dtype="bfloat16"),
+    batch_size=4,
+    seq_len=1024,
+    mesh_axes={},
+    train_overrides=dict(dtype="bfloat16", optimizer="adamw"),
+    note="bench.py headline configuration (HEADLINE_MODEL_KWARGS, "
+         "seq 1024, adamw bf16). Single chip: zero collectives, zero "
+         "reshard warnings — any finding here is a regression.",
+))
+
+
+def resolve(names=None) -> list[AuditTarget]:
+    """Targets by name (all when ``names`` is falsy); unknown names
+    raise with the available set spelled out."""
+    if not names:
+        return list(TARGETS.values())
+    out = []
+    for n in names:
+        if n not in TARGETS:
+            raise KeyError(
+                f"unknown audit target '{n}'; available: "
+                f"{sorted(TARGETS)}")
+        out.append(TARGETS[n])
+    return out

@@ -86,10 +86,15 @@ def _resolve_blocks(block_q: int, block_k: int, seq_q: int, seq_k: int,
     return (min(block_q, seq_q) if block_q else dq,
             min(block_k, seq_k) if block_k else dk)
 
+# jax < 0.4.38 spells it TPUCompilerParams (same fields); resolve the
+# modern name first so this module imports on both vintages.
+_CompilerParams = getattr(pltpu, "CompilerParams", None) or \
+    pltpu.TPUCompilerParams
+
 # Every kernel here runs a (B, H, outer, inner) grid where only the
 # innermost dim carries accumulation order (fwd/dq: k-blocks; dkv:
 # q-blocks) — declaring the rest parallel lets Mosaic pipeline them.
-_DIM_SEMANTICS = pltpu.CompilerParams(
+_DIM_SEMANTICS = _CompilerParams(
     dimension_semantics=("parallel", "parallel", "parallel",
                          "arbitrary"))
 
@@ -554,7 +559,7 @@ def _flash_bwd_fused(q, k, v, lse, do, delta, *, causal, block_q,
             pltpu.VMEM((block_k, D), jnp.float32),
         ],
         # Both trailing dims carry accumulation order here.
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary",
                                  "arbitrary")),
         interpret=not _platform_is_tpu(),

@@ -1,0 +1,28 @@
+"""Cross-version jax shims for the parallel kernels.
+
+The parallel layer targets the newest jax API surface, but the repo
+must stay importable (and compilable — the static SPMD auditor in
+``analysis/`` lowers the ring/pipeline paths on every run) on the
+container's pinned jaxlib. Each shim resolves the modern name when it
+exists and otherwise maps onto the older spelling of the same
+primitive — never a behavioral emulation, only a rename bridge.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def axis_size(axis_name) -> int:
+    """Static size of a named mesh axis inside a traced context
+    (shard_map/pmap body). ``jax.lax.axis_size`` exists from
+    jax 0.4.38; older releases expose the same number through the axis
+    environment (``jax.core.axis_frame``, which returns either a frame
+    object carrying ``.size`` or, on some releases, the size itself).
+    """
+    if hasattr(jax.lax, "axis_size"):
+        return jax.lax.axis_size(axis_name)
+    import jax.core as jcore
+
+    frame = jcore.axis_frame(axis_name)
+    return int(getattr(frame, "size", frame))

@@ -165,7 +165,10 @@ def train_ddp(world_size: int | None = None, epochs: int = 3,
         out_specs=(P(), metric_specs),
         check_rep=False,
     )
-    step = jax.jit(step, static_argnames=())
+    # Donate the params buffer: the caller rebinds ``params`` to the
+    # step's output every iteration, so the old copy is dead — same
+    # contract as the production trainer's donate_argnums=(0,).
+    step = jax.jit(step, static_argnames=(), donate_argnums=(0,))
 
     steps_per_epoch = sampler.num_samples // batch_size
     history: list[dict] = []

@@ -26,8 +26,12 @@ an estimate of traffic per step per device, not a link-level model.
 
 from __future__ import annotations
 
+import contextlib
 import itertools
+import os
 import re
+import sys
+import tempfile
 from collections import defaultdict
 
 import numpy as np
@@ -41,7 +45,7 @@ SCHEMA = 1
 # multi-host summaries both filter through this, so a SCHEMA bump
 # cannot leave the two reports disagreeing about which keys exist.
 SUMMARY_KEYS = ("schema", "total_collectives", "bytes_per_step",
-                "by_kind", "by_axis", "mesh")
+                "by_kind", "by_axis", "mesh", "spmd_reshard_warnings")
 
 
 def summary_of_event(rec: dict) -> dict:
@@ -66,6 +70,11 @@ def render_lines(coll: dict) -> list[str]:
                           key=lambda kv: -kv[1]["bytes"]):
         lines.append(f"  axis {axis:10s} x{v['count']:3d}  "
                      f"{v['bytes'] / 1e6:9.3f} MB")
+    if coll.get("spmd_reshard_warnings"):
+        lines.append(
+            f"  SPMD reshard warnings: {coll['spmd_reshard_warnings']} "
+            "(involuntary full rematerialization — see "
+            "docs/static-analysis.md)")
     return lines
 
 COLLECTIVE_KINDS = ("all-reduce", "all-gather", "reduce-scatter",
@@ -111,6 +120,79 @@ _RS_COMPUTATION = re.compile(r"^(%all-reduce-scatter[\w.\-]*)\s", re.M)
 _GROUPS_EXPLICIT = re.compile(r"replica_groups=\{(\{[\d, \{\}]*\})\}")
 _GROUPS_IOTA = re.compile(
     r"replica_groups=\[([\d,]+)\]<=\[([\d,]+)\](?:T\(([\d,]+)\))?")
+
+
+# ---------------------------------------------------------------------------
+# SPMD-partitioner diagnostics. XLA's spmd_partitioner.cc reports the
+# "Involuntary full rematerialization" cliff (it must fully replicate a
+# tensor to move between two shardings — silent extra traffic that
+# scales with the tensor, exactly the pod-scale perf cliff ROADMAP item
+# 1 gates on) as a C++ log line on the process's stderr FD. It never
+# surfaces through any Python API, so the only faithful way to observe
+# it is to capture fd 2 around the ``.compile()`` call. Wording differs
+# across XLA vintages ("cannot go from sharding X to Y efficiently" vs
+# "was not able to go from sharding X to Y without doing a full
+# rematerialization"); the regexes below accept both.
+# ---------------------------------------------------------------------------
+
+RESHARD_MARKER = "Involuntary full rematerialization"
+_RESHARD_SHARDINGS = re.compile(
+    r"from sharding \{(.*?)\} to \{(.*?)\}")
+_RESHARD_OP = re.compile(
+    r"for HLO operation:?\s+%([\w.\-]+)\s*=\s*(\w+)\[([\d,]*)\]")
+
+
+@contextlib.contextmanager
+def capture_stderr_fd():
+    """Capture everything written to the stderr FILE DESCRIPTOR (not
+    just ``sys.stderr``) for the duration of the block — C++ XLA logs
+    bypass the Python-level stream. Yields an object whose ``.text``
+    holds the captured bytes after exit. Anything captured is swallowed
+    from the real stderr (including unrelated concurrent writers, e.g.
+    logging from other threads), so keep the window tight: one compile.
+    """
+    class _Cap:
+        text = ""
+
+    cap = _Cap()
+    sys.stderr.flush()
+    saved = os.dup(2)
+    tmp = tempfile.TemporaryFile(mode="w+b")
+    try:
+        os.dup2(tmp.fileno(), 2)
+        yield cap
+    finally:
+        sys.stderr.flush()
+        os.dup2(saved, 2)
+        os.close(saved)
+        tmp.seek(0)
+        cap.text = tmp.read().decode("utf-8", "replace")
+        tmp.close()
+
+
+def parse_reshard_warnings(stderr_text: str) -> list[dict]:
+    """Structured rows for every involuntary-reshard warning in a
+    captured compile stderr: op name/dtype/shape plus the source and
+    destination shardings the partitioner could not bridge. Fields
+    the vintage's wording omits come back empty rather than missing."""
+    rows: list[dict] = []
+    for line in stderr_text.splitlines():
+        if RESHARD_MARKER not in line:
+            continue
+        row = {"op": "", "dtype": "", "shape": "",
+               "from_sharding": "", "to_sharding": "",
+               "raw": line.strip()[:2000]}
+        m = _RESHARD_SHARDINGS.search(line)
+        if m:
+            row["from_sharding"], row["to_sharding"] = m.groups()
+        m = _RESHARD_OP.search(line)
+        if m:
+            # Strip SSA numeric suffixes (%gather.123 → gather) so the
+            # fingerprint survives unrelated HLO renumbering.
+            row["op"] = re.sub(r"[.\d]+$", "", m.group(1))
+            row["dtype"], row["shape"] = m.group(2), m.group(3)
+        rows.append(row)
+    return rows
 
 
 def _bytes_of(dtype: str, shape: str) -> int:

@@ -548,11 +548,19 @@ class Trainer:
         abstract = state_lib.abstract_state(
             self.model, self.optimizer, self.init_rng,
             self._device_state_shardings)
-        text = self._step_fn.lower(
-            abstract, batch, self.step_rng).compile().as_text()
+        # Compile under an fd-level stderr capture: the SPMD
+        # partitioner's "Involuntary full rematerialization" cliff is
+        # only ever reported as a C++ log line, and the ledger must
+        # carry that count mechanically (analysis/ gates on the same
+        # parse) instead of via a log-tail grep.
+        with collectives.capture_stderr_fd() as cap:
+            text = self._step_fn.lower(
+                abstract, batch, self.step_rng).compile().as_text()
         rep = collectives.audit_hlo_text(text, mesh=self.rt.mesh)
         rep["mesh"] = {a: s for a, s in self.rt.spec.as_dict().items()
                        if s > 1}
+        rep["spmd_reshard_warnings"] = len(
+            collectives.parse_reshard_warnings(cap.text))
         return rep
 
     def _maybe_emit_collectives(self, batch) -> None:
@@ -654,8 +662,10 @@ class Trainer:
                 self.faults.on_step(self.global_step)
             if self._agreed_stop():
                 break
-        # One host sync per epoch, not per step.
-        mean_loss = float(np.mean([float(x) for x in losses]))
+        # One host sync per epoch, not per step — THE deliberate sync
+        # point the DTT003 rule exists to protect (everything above
+        # dispatches async; this drain happens once per epoch).
+        mean_loss = float(np.mean([float(x) for x in losses]))  # noqa: DTT003 — epoch-end drain by design
         return {"epoch": epoch, "mean_loss": mean_loss}
 
     def train(self, max_epochs: int | None = None) -> dict[str, float]:
@@ -783,4 +793,6 @@ class Trainer:
                 count += 1
             if count == 0:
                 return float("nan")
-            return float(total) / count
+            # The one host sync per EVALUATION (see docstring): eval
+            # batches above dispatch async; this drains them all.
+            return float(total) / count  # noqa: DTT003 — by design

@@ -1,0 +1,417 @@
+"""The static-analysis subsystem: SPMD auditor + pitfall rules.
+
+Three layers, three speeds:
+- pitfall rules (DTT003–DTT006): pure-AST fixtures, instant;
+- ratchet arithmetic (baseline.py): synthetic findings, instant;
+- the auditor itself: REAL compiles of the two named targets on the
+  conftest-faked 8-device CPU mesh — the tp+sp+fsdp dryrun config
+  must reproduce the involuntary-reshard finding MULTICHIP_r05.json
+  recorded from the log tail, and the single-chip headline config
+  must audit clean. Module-scoped fixtures so each target compiles
+  once per run.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from distributed_training_tpu.analysis import (audit, baseline,
+                                               pitfalls, targets)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _rules(tmp_path, src, name="x.py"):
+    p = tmp_path / name
+    p.write_text(src)
+    return pitfalls.check_file_rules(str(p), repo=str(tmp_path))
+
+
+# ---------------------------------------------------------------------------
+# Registry plumbing
+# ---------------------------------------------------------------------------
+
+
+def test_registry_has_all_rules():
+    assert {"DTT001", "DTT002", "DTT003", "DTT004", "DTT005",
+            "DTT006"} <= set(pitfalls.RULES)
+
+
+def test_tests_directory_is_exempt(tmp_path):
+    (tmp_path / "tests").mkdir()
+    p = tmp_path / "tests" / "fixture.py"
+    p.write_text("f = open('events.jsonl', 'w')\n")
+    assert pitfalls.check_file_rules(str(p), repo=str(tmp_path)) == []
+
+
+# ---------------------------------------------------------------------------
+# DTT003 — host sync in the hot step path
+# ---------------------------------------------------------------------------
+
+_HOT = {"hot.py": {"train_step"}}
+
+
+def test_dtt003_flags_host_syncs(tmp_path, monkeypatch):
+    monkeypatch.setattr(pitfalls, "DTT003_HOT_PATHS", _HOT)
+    problems = _rules(tmp_path, (
+        "def train_step(self, batch):\n"
+        "    loss = metrics['loss'].item()\n"
+        "    x = float(metrics['loss'])\n"
+        "    y = jax.device_get(metrics)\n"
+        "    arr.block_until_ready()\n"), name="hot.py")
+    assert len([p for p in problems if "DTT003" in p]) == 4, problems
+
+
+def test_dtt003_scoping(tmp_path, monkeypatch):
+    monkeypatch.setattr(pitfalls, "DTT003_HOT_PATHS", _HOT)
+    # Not a hot function / not a hot file / constant cast / noqa.
+    assert not _rules(tmp_path, (
+        "def helper(x):\n    return float(x)\n"), name="hot.py")
+    assert not _rules(tmp_path, (
+        "def train_step(x):\n    return float(x)\n"), name="cold.py")
+    assert not _rules(tmp_path, (
+        "def train_step(x):\n    return float('nan')\n"),
+        name="hot.py")
+    assert not _rules(tmp_path, (
+        "def train_step(x):\n"
+        "    return float(x)  # noqa: DTT003 — epoch drain\n"),
+        name="hot.py")
+
+
+# ---------------------------------------------------------------------------
+# DTT004 — collective under a host-local condition
+# ---------------------------------------------------------------------------
+
+
+def test_dtt004_flags_host_local_guards(tmp_path):
+    problems = _rules(tmp_path, (
+        "def f(self, x):\n"
+        "    if self.rt.is_coordinator:\n"
+        "        multihost_utils.process_allgather(x)\n"
+        "def g(self, x, t0):\n"
+        "    while time.perf_counter() - t0 < 5:\n"
+        "        jax.lax.psum(x, 'dp')\n"))
+    hits = [p for p in problems if "DTT004" in p]
+    assert len(hits) == 2, problems
+    assert "is_coordinator" in hits[0]
+    assert "perf_counter" in hits[1]
+
+
+def test_dtt004_step_cadence_passes(tmp_path):
+    # The straggler/faults discipline: cadence from global_step only.
+    assert not _rules(tmp_path, (
+        "def f(self, x, global_step):\n"
+        "    if global_step % self.every == 0:\n"
+        "        multihost_utils.process_allgather(x)\n"
+        "    if jax.process_count() > 1:\n"
+        "        multihost_utils.sync_global_devices('tag')\n"))
+
+
+# ---------------------------------------------------------------------------
+# DTT005 — PRNG key reuse
+# ---------------------------------------------------------------------------
+
+
+def test_dtt005_flags_key_reuse(tmp_path):
+    problems = _rules(tmp_path, (
+        "def f():\n"
+        "    key = jax.random.PRNGKey(0)\n"
+        "    a = jax.random.normal(key, (2,))\n"
+        "    b = jax.random.uniform(key, (2,))\n"))
+    assert len([p for p in problems if "DTT005" in p]) == 1, problems
+
+
+def test_dtt005_flags_parameter_key_reuse(tmp_path):
+    """Keys threaded in as function parameters are the common real
+    reuse pattern — the rule tracks them, not just maker-bound
+    names; non-key args (shapes, counts) in later positions never
+    count as consumptions."""
+    problems = _rules(tmp_path, (
+        "def apply(params, key):\n"
+        "    a = jax.random.normal(key, (2,))\n"
+        "    b = jax.random.bernoulli(key, 0.5)\n"))
+    assert len([p for p in problems if "DTT005" in p]) == 1, problems
+    assert not _rules(tmp_path, (
+        "def apply(params, key, key2, n):\n"
+        "    a = jax.random.normal(key, n)\n"
+        "    b = jax.random.uniform(key2, n)\n"))
+
+
+def test_dtt005_split_and_rebind_pass(tmp_path):
+    assert not _rules(tmp_path, (
+        "def f():\n"
+        "    key = jax.random.PRNGKey(0)\n"
+        "    k1, k2 = jax.random.split(key)\n"
+        "    a = jax.random.normal(k1, (2,))\n"
+        "    b = jax.random.uniform(k2, (2,))\n"))
+    # fold_in between consumptions is a rebind, not a reuse.
+    assert not _rules(tmp_path, (
+        "def f():\n"
+        "    key = jax.random.PRNGKey(0)\n"
+        "    a = jax.random.normal(key, (2,))\n"
+        "    key = jax.random.fold_in(key, 1)\n"
+        "    b = jax.random.normal(key, (2,))\n"))
+
+
+# ---------------------------------------------------------------------------
+# DTT006 — undonated jitted train step
+# ---------------------------------------------------------------------------
+
+
+def test_dtt006_flags_undonated_step(tmp_path):
+    problems = _rules(tmp_path, (
+        "step = jax.jit(train_step)\n"
+        "self._step_fn = jax.jit(make_train_step(model))\n"))
+    assert len([p for p in problems if "DTT006" in p]) == 2, problems
+
+
+def test_dtt006_donated_or_unrelated_pass(tmp_path):
+    assert not _rules(tmp_path, (
+        "step = jax.jit(train_step, donate_argnums=(0,))\n"
+        "fn = jax.jit(make_train_step(m), donate_argnames=('state',))\n"
+        "eval_fn = jax.jit(evaluate)\n"
+        "helper = jax.jit(lambda x: x)\n"))
+
+
+def test_dtt006_decorator_forms(tmp_path):
+    """@jax.jit and @partial(jax.jit, ...) are the common ways a step
+    gets jitted — the rule must see them, not just the call form."""
+    problems = _rules(tmp_path, (
+        "@jax.jit\n"
+        "def train_step(state, batch):\n"
+        "    return state\n"
+        "@partial(jax.jit, static_argnames=('n',))\n"
+        "def update_step(state, batch, n):\n"
+        "    return state\n"))
+    assert len([p for p in problems if "DTT006" in p]) == 2, problems
+    assert not _rules(tmp_path, (
+        "@partial(jax.jit, donate_argnums=(0,))\n"
+        "def train_step(state, batch):\n"
+        "    return state\n"
+        "@jax.jit\n"
+        "def render_frame(x):\n"
+        "    return x\n"))
+
+
+# ---------------------------------------------------------------------------
+# Ratchet (baseline.py)
+# ---------------------------------------------------------------------------
+
+
+def _f(fp):
+    return {"code": fp.split(":")[0], "target": "t",
+            "fingerprint": fp, "message": fp, "detail": {}}
+
+
+def test_ratchet_baseline_suppresses_old_fails_new(tmp_path):
+    findings = [_f("SPMD001:t:a"), _f("SPMD002:t:b")]
+    path = str(tmp_path / "base.json")
+    baseline.write(findings, path=path)
+    # Same findings: nothing new, nothing stale.
+    cmp = baseline.compare(findings, baseline.load(path))
+    assert not cmp["new"] and not cmp["stale"]
+    assert len(cmp["known"]) == 2
+    # A new finding fails; a fixed one goes stale (not a failure).
+    cmp = baseline.compare(
+        [findings[0], _f("SPMD001:t:c")], baseline.load(path))
+    assert [f["fingerprint"] for f in cmp["new"]] == ["SPMD001:t:c"]
+    assert cmp["stale"] == ["SPMD002:t:b"]
+
+
+def test_ratchet_subset_run_scopes_stale_to_selected_targets(tmp_path):
+    """A subset audit must not call other targets' baseline entries
+    stale — 'not re-checked' is not 'fixed'."""
+    path = str(tmp_path / "base.json")
+    baseline.write([_f("SPMD001:alpha:x"), _f("SPMD001:beta:y")],
+                   path=path)
+    cmp = baseline.compare([_f("SPMD001:alpha:x")],
+                           baseline.load(path), targets=["alpha"])
+    assert not cmp["new"] and not cmp["stale"]
+    # ...but a genuinely vanished finding of a SELECTED target is.
+    cmp = baseline.compare([], baseline.load(path), targets=["alpha"])
+    assert cmp["stale"] == ["SPMD001:alpha:x"]
+
+
+def test_ratchet_missing_baseline_is_empty(tmp_path):
+    cmp = baseline.compare([_f("SPMD001:t:a")],
+                           baseline.load(str(tmp_path / "nope.json")))
+    assert len(cmp["new"]) == 1
+
+
+def test_ratchet_schema_mismatch_raises(tmp_path):
+    p = tmp_path / "bad.json"
+    p.write_text(json.dumps({"schema": 99, "fingerprints": []}))
+    with pytest.raises(ValueError, match="schema"):
+        baseline.load(str(p))
+
+
+# ---------------------------------------------------------------------------
+# Reshard-warning parsing (both XLA wordings)
+# ---------------------------------------------------------------------------
+
+_OLD_STYLE = (
+    "2026-08-03 21:44:58.072291: E external/xla/xla/service/spmd/"
+    "spmd_partitioner.cc:613] [spmd] Involuntary full "
+    "rematerialization. The compiler was not able to go from sharding "
+    "{devices=[1,1,2,4]<=[8] last_tile_dim_replicate} to "
+    "{devices=[2,2,1,2]<=[8] last_tile_dim_replicate} without doing a "
+    "full rematerialization of the tensor for HLO operation: %gather "
+    "= f32[4,32,32]{2,1,0} gather(f32[256,32]{1,0} %all-gather, "
+    "s32[4,32,1]{2,1,0} %all-gather), offset_dims={2}, "
+    "sharding={devices=[1,1,2,4]<=[8] last_tile_dim_replicate}.\n")
+_NEW_STYLE = (
+    "W0802 18:12:53.222904 7842 spmd_partitioner.cc:652] [SPMD] "
+    "Involuntary full rematerialization. The compiler cannot go from "
+    "sharding {devices=[1,1,2,4]<=[8] last_tile_dim_replicate} to "
+    "{devices=[2,2,1,2]<=[8] last_tile_dim_replicate} efficiently for "
+    "HLO operation %all-gather = f32[4,32,32]{2,1,0} "
+    "all-gather(%all-reduce), channel_id=91.\n")
+
+
+def test_parse_reshard_warnings_both_vintages():
+    from distributed_training_tpu.telemetry.collectives import (
+        parse_reshard_warnings)
+    rows = parse_reshard_warnings(_OLD_STYLE + _NEW_STYLE + "noise\n")
+    assert len(rows) == 2
+    assert rows[0]["op"] == "gather"
+    assert rows[1]["op"] == "all-gather"
+    for r in rows:
+        assert r["dtype"] == "f32" and r["shape"] == "4,32,32"
+        assert "devices=[1,1,2,4]" in r["from_sharding"]
+        assert "devices=[2,2,1,2]" in r["to_sharding"]
+
+
+def test_capture_stderr_fd_sees_fd_writes():
+    from distributed_training_tpu.telemetry.collectives import (
+        capture_stderr_fd)
+    with capture_stderr_fd() as cap:
+        os.write(2, b"fd-level write\n")
+    assert "fd-level write" in cap.text
+
+
+# ---------------------------------------------------------------------------
+# The auditor: real compiles of the named targets
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def tp_sp_fsdp_report():
+    return audit.audit_target(
+        targets.TARGETS["multichip_r05_tp_sp_fsdp"])
+
+
+@pytest.fixture(scope="module")
+def headline_report():
+    return audit.audit_target(
+        targets.TARGETS["single_chip_headline"])
+
+
+def test_auditor_reproduces_multichip_r05_resharding(
+        tp_sp_fsdp_report):
+    """The gather-resharding repro from MULTICHIP_r05.json, now a
+    machine-checked finding instead of a log-tail grep: same ops
+    (%gather + %all-gather), same tensor f32[4,32,32], same
+    sharding transition."""
+    r = tp_sp_fsdp_report
+    assert r["spmd_reshard_warnings"] >= 2
+    spmd001 = [f for f in r["findings"] if f["code"] == "SPMD001"]
+    ops = {f["detail"]["op"] for f in spmd001}
+    assert {"gather", "all-gather"} <= ops
+    for f in spmd001:
+        assert f["detail"]["shape"] == "4,32,32"
+        assert "devices=[1,1,2,4]" in f["detail"]["from_sharding"]
+        assert "devices=[2,2,1,2]" in f["detail"]["to_sharding"]
+    # The collectives event carries the count mechanically.
+    assert r["collectives"]["spmd_reshard_warnings"] == \
+        r["spmd_reshard_warnings"]
+
+
+def test_auditor_headline_config_is_clean(headline_report):
+    r = headline_report
+    assert r["findings"] == []
+    assert r["spmd_reshard_warnings"] == 0
+    assert r["collectives"]["total_collectives"] == 0
+
+
+def test_committed_baseline_is_exactly_current(tp_sp_fsdp_report,
+                                               headline_report):
+    """The ratchet contract on HEAD: every current finding is known
+    (no red CI on a clean tree) and no baseline entry is stale (no
+    dead suppressions hiding future regressions)."""
+    findings = (tp_sp_fsdp_report["findings"]
+                + headline_report["findings"])
+    cmp = baseline.compare(findings, baseline.load())
+    assert not cmp["new"], [f["fingerprint"] for f in cmp["new"]]
+    assert not cmp["stale"], cmp["stale"]
+
+
+def test_new_finding_would_fail_check(tp_sp_fsdp_report):
+    """Ratchet end-to-end: drop one baselined fingerprint and the
+    same findings produce a NEW entry — what --check exits 1 on."""
+    base = baseline.load()
+    trimmed = {"schema": baseline.SCHEMA,
+               "fingerprints": base["fingerprints"][1:]}
+    cmp = baseline.compare(tp_sp_fsdp_report["findings"], trimmed)
+    assert len(cmp["new"]) == 1
+
+
+def test_audit_targets_document_shape(tp_sp_fsdp_report):
+    """spmd_audit.json contract: schema 1, per-target records with
+    findings + collective summaries, totals consistent, and the
+    rendered report tagging findings against the baseline. Assembled
+    from the module-scoped record — no recompile."""
+    doc = audit.assemble_doc([tp_sp_fsdp_report])
+    assert doc["schema"] == 1
+    (rec,) = doc["targets"]
+    assert rec["target"] == "multichip_r05_tp_sp_fsdp"
+    assert rec["mesh"] == {"fsdp": 2, "sp": 2, "tp": 2}
+    assert doc["totals"]["findings"] == len(rec["findings"])
+    assert doc["totals"]["by_code"].get("SPMD001", 0) >= 2
+    # Render must tag known findings against the committed baseline.
+    cmp = baseline.compare(audit.all_findings(doc), baseline.load())
+    lines = "\n".join(audit.render_report(doc, cmp))
+    assert "[known]" in lines and "SPMD001" in lines
+
+
+# ---------------------------------------------------------------------------
+# Trainer satellite: the collectives event carries the reshard count
+# ---------------------------------------------------------------------------
+
+
+def test_trainer_collectives_report_carries_reshard_count():
+    from distributed_training_tpu.analysis.compile import (
+        build_abstract_trainer)
+    from distributed_training_tpu.telemetry.collectives import (
+        SUMMARY_KEYS, summary_of_event)
+    trainer, _rt, batch = build_abstract_trainer(
+        2, "ddp", "transformer",
+        dict(vocab_size=64, d_model=16, n_heads=2, n_layers=1,
+             max_seq_len=8, dtype="float32"),
+        batch_size=2, seq_len=8,
+        train_overrides=dict(min_shard_elems=1, dtype="float32"))
+    rep = trainer.collectives_report(batch)
+    assert rep["spmd_reshard_warnings"] == 0
+    assert "spmd_reshard_warnings" in SUMMARY_KEYS
+    assert summary_of_event(rep)["spmd_reshard_warnings"] == 0
+
+
+# ---------------------------------------------------------------------------
+# CLI guards (cheap arg-validation paths; the full --check subprocess
+# runs once, in tests/test_lint_local.py, as the tier-1 gate)
+# ---------------------------------------------------------------------------
+
+
+def test_cli_write_baseline_refuses_target_subset():
+    """A subset run must never rewrite the committed baseline — the
+    unselected targets' known fingerprints would vanish and the next
+    full --check would fail on them as NEW."""
+    out = subprocess.run(
+        [sys.executable, "-m", "distributed_training_tpu.analysis",
+         "--no-rules", "--targets", "single_chip_headline",
+         "--write-baseline"],
+        capture_output=True, text=True, timeout=300, cwd=REPO)
+    assert out.returncode == 2, out.stdout + out.stderr
+    assert "full run" in out.stderr

@@ -10,6 +10,16 @@ from distributed_training_tpu.ops.attention import _naive_attention
 from distributed_training_tpu.ops.flash_attention import (flash_attention,
                                                           supported)
 
+# This container's pinned jax runs the Pallas kernels in interpret
+# mode and the ring/pipeline numerics at minutes per test — far over
+# the tier-1 wall-clock budget (the whole file was broken-at-import
+# at seed, so the fast gate never paid for it). The fast gate still
+# COMPILES these paths every run (the analysis SPMD audit target
+# lowers ring attention under the full sharded train step; the
+# test_benchmarks contract tests compile the strategy matrix); the
+# kernel/numerics suites here run via `pytest -m slow`.
+pytestmark = pytest.mark.slow
+
 
 def rand_qkv(B=1, S=256, H=2, D=32, Hkv=None, dtype=jnp.float32, seed=0):
     Hkv = Hkv or H

@@ -4,11 +4,16 @@ The CI lint job mirrors the reference's four gates
 (black/flake8/isort/mypy, reference .github/workflows/lint.yml:20-25)
 but has never executed in this container — no runner, no tools, no
 network. tools/lint_local.py implements the mechanically-checkable
-subset (E501/W291/W293/W191/E711/E712/F401 + import-group order); this
-test makes `pytest tests/` red when a violation lands, which is the
-"gates have actually run on HEAD" evidence the CI job cannot provide
-here. black formatting and mypy typing remain CI-only (documented in
-tools/lint_local.py — no pretend coverage).
+subset (E501/W291/W293/W191/E711/E712/F401 + import-group order) plus
+the DTT00x pitfall-rule registry shared with
+``distributed_training_tpu/analysis/pitfalls.py``; this test makes
+`pytest tests/` red when a violation lands, which is the "gates have
+actually run on HEAD" evidence the CI job cannot provide here. The
+full static-analysis gate (``python -m distributed_training_tpu
+.analysis --check`` — pitfall rules AND the SPMD audit ratchet) runs
+here too, so tier-1 is red on any new audit finding. black formatting
+and mypy typing remain CI-only (documented in tools/lint_local.py —
+no pretend coverage).
 """
 
 import os
@@ -23,6 +28,31 @@ def test_repo_passes_local_lint_subset():
         [sys.executable, os.path.join(REPO, "tools", "lint_local.py")],
         capture_output=True, text=True, timeout=120)
     assert out.returncode == 0, f"lint violations:\n{out.stdout}"
+
+
+def test_repo_passes_static_analysis_check():
+    """The full gate: DTT rules clean AND the SPMD audit reproduces
+    only baselined findings (ratchet). Any new involuntary-reshard
+    warning, unattributed collective, or replicated large param on a
+    named target makes this red — the log-tail grep over
+    MULTICHIP_*.json is no longer the evidence."""
+    out = subprocess.run(
+        [sys.executable, "-m", "distributed_training_tpu.analysis",
+         "--check", "--json", "-"],
+        capture_output=True, text=True, timeout=600, cwd=REPO)
+    assert out.returncode == 0, out.stdout + "\n" + out.stderr
+
+
+def test_lint_and_analysis_share_one_rule_table():
+    """lint_local must run the registry, not a private copy — the
+    two gates drifting is the failure mode the refactor removes."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import lint_local
+    finally:
+        sys.path.pop(0)
+    assert {"DTT001", "DTT002", "DTT003", "DTT004", "DTT005",
+            "DTT006"} <= set(lint_local.pitfalls.RULES)
 
 
 def test_lint_local_catches_violations(tmp_path):

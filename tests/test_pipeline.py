@@ -16,6 +16,16 @@ from distributed_training_tpu.parallel.pipeline import pipeline_apply
 from distributed_training_tpu.runtime import fake_cpu_runtime
 from distributed_training_tpu.train.trainer import Trainer
 
+# This container's pinned jax runs the Pallas kernels in interpret
+# mode and the ring/pipeline numerics at minutes per test — far over
+# the tier-1 wall-clock budget (the whole file was broken-at-import
+# at seed, so the fast gate never paid for it). The fast gate still
+# COMPILES these paths every run (the analysis SPMD audit target
+# lowers ring attention under the full sharded train step; the
+# test_benchmarks contract tests compile the strategy matrix); the
+# kernel/numerics suites here run via `pytest -m slow`.
+pytestmark = pytest.mark.slow
+
 
 def test_pipeline_apply_matches_sequential():
     """The wavefront schedule must equal running all layers in order."""

@@ -712,19 +712,31 @@ def test_wait_forwards_sigterm_to_children(tmp_path):
     """When the LAUNCHER is signaled mid-wait, children must receive
     the signal (their PreemptionGuard path) and the launcher reaps
     them cleanly instead of orphaning them."""
+    ready = tmp_path / "handler_installed"
     procs = launch_local_mod.launch_local(
         ["-c",
          "import signal, sys, time\n"
          "signal.signal(signal.SIGTERM, lambda *a: sys.exit(0))\n"
+         f"open({str(ready)!r}, 'w').close()\n"
          "time.sleep(600)\n"],
         num_processes=1, log_dir=str(tmp_path))
-    timer = threading.Timer(
-        0.5, signal.raise_signal, [signal.SIGTERM])
+
+    def _signal_when_ready():
+        # Signal only after the child has INSTALLED its handler — a
+        # fixed pre-signal delay races python startup under suite
+        # load, and a child killed by default SIGTERM (-15) is a
+        # startup race, not the forwarding bug this test guards.
+        deadline = time.time() + 30
+        while not ready.exists() and time.time() < deadline:
+            time.sleep(0.02)
+        signal.raise_signal(signal.SIGTERM)
+
+    timer = threading.Thread(target=_signal_when_ready, daemon=True)
     timer.start()
     try:
         code = launch_local_mod.wait(procs, timeout=60)
     finally:
-        timer.cancel()
+        timer.join(timeout=35)
         launch_local_mod._launcher_signaled = False
     assert code == 0  # child exited 0 FROM ITS HANDLER, not killed
 

@@ -11,6 +11,16 @@ import pytest
 from distributed_training_tpu.ops import flash_attention as fa
 from distributed_training_tpu.ops.attention import _naive_attention
 
+# This container's pinned jax runs the Pallas kernels in interpret
+# mode and the ring/pipeline numerics at minutes per test — far over
+# the tier-1 wall-clock budget (the whole file was broken-at-import
+# at seed, so the fast gate never paid for it). The fast gate still
+# COMPILES these paths every run (the analysis SPMD audit target
+# lowers ring attention under the full sharded train step; the
+# test_benchmarks contract tests compile the strategy matrix); the
+# kernel/numerics suites here run via `pytest -m slow`.
+pytestmark = pytest.mark.slow
+
 
 def rand_qkv(B=2, S=256, H=4, D=16, Hkv=None, seed=0):
     Hkv = Hkv or H

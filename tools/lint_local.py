@@ -17,24 +17,14 @@ is red when a violation lands:
 - isort subset (profile=black): within each contiguous top-of-file
   import block, `import`-group ordering stdlib < third-party <
   first-party and alphabetical order inside each group.
-- DTT001 (repo rule, not flake8): a write-mode ``open`` of a
-  ``*jsonl*`` stream anywhere outside the telemetry/metrics sinks.
-  Event emission MUST go through ``telemetry/events.py`` — a bare
-  jsonl write skips host tagging and the multi-host aggregator
-  (telemetry/aggregate.py) silently mis-attributes the records.
-  ``tests/`` is exempt (fixtures hand-write synthetic streams);
-  derived artifacts (postmortem event tails, merged timelines) carry
-  an inline ``# noqa``.
-- DTT002 (repo rule): a broad silent swallow — ``except:`` /
-  ``except Exception:`` / ``except BaseException:`` whose body is
-  only ``pass``. Silent swallows are how recovery bugs hide
-  (resilience/: a quarantine that "succeeds" by eating its own
-  OSError is indistinguishable from one that worked). Handlers that
-  genuinely must swallow (best-effort postmortem paths) either log a
-  breadcrumb or carry ``# noqa: DTT002`` on the ``except`` line, or
-  their file is named in ``DTT002_ALLOWLIST``. Narrow handlers
-  (``except FileNotFoundError: pass``) are fine — naming the
-  exception is the evidence the swallow was a decision.
+- DTT001–DTT006 (repo rules, not flake8): the JAX-pitfall rule
+  registry in ``distributed_training_tpu/analysis/pitfalls.py`` —
+  bare jsonl writes, silent broad swallows, hot-path host syncs,
+  host-local collective guards, PRNG key reuse, undonated train
+  steps. The registry is loaded BY PATH (not imported as a package
+  module) so linting never imports jax; the same table backs
+  ``python -m distributed_training_tpu.analysis --check``, so the
+  two gates cannot drift. Rule catalog: docs/static-analysis.md.
 - black / mypy: NOT locally enforceable without the tools; they
   remain CI-only. This file documents that boundary explicitly
   instead of pretending coverage.
@@ -43,8 +33,8 @@ is red when a violation lands:
 from __future__ import annotations
 
 import ast
+import importlib.util
 import os
-import re
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -54,42 +44,27 @@ FIRST_PARTY = ("distributed_training_tpu",)
 # exact for the running interpreter (3.10+).
 STDLIB = set(getattr(sys, "stdlib_module_names", ()))
 
-SKIP_DIRS = {".git", "__pycache__", "outputs", "_build", ".venv",
-             "state", "evidence", "postmortem"}
-
-# The only modules allowed to open a jsonl stream for writing: the
-# event sink (host tagging lives there) and the metrics logger (its
-# own sink, predating telemetry; metrics.jsonl is not an event
-# stream). Everything else must emit through telemetry/events.py.
-JSONL_SINKS = {
-    os.path.join("distributed_training_tpu", "telemetry", "events.py"),
-    os.path.join("distributed_training_tpu", "utils", "metrics.py"),
-}
-_WRITE_CHARS = set("wax+")
-
-# DTT002: files allowed to contain broad `except ...: pass` swallows.
-# Deliberately empty — every current swallow either logs a breadcrumb
-# or carries an inline `# noqa: DTT002` with its justification; add a
-# path here only when a whole file is best-effort by design.
-DTT002_ALLOWLIST: set[str] = set()
-_BROAD_EXC_NAMES = {"Exception", "BaseException"}
+def _load_pitfalls():
+    """Load the shared DTT rule registry by file path — the package
+    ``__init__`` imports jax, which the lint gate must never pay for
+    (nor depend on: lint must run on a box with a broken backend)."""
+    path = os.path.join(REPO, "distributed_training_tpu", "analysis",
+                        "pitfalls.py")
+    spec = importlib.util.spec_from_file_location("dtt_pitfalls", path)
+    mod = importlib.util.module_from_spec(spec)
+    # dataclasses resolves cls.__module__ through sys.modules — a
+    # path-loaded module must be registered or it fails on py3.10.
+    sys.modules.setdefault("dtt_pitfalls", mod)
+    spec.loader.exec_module(mod)
+    return mod
 
 
-def _noqa_allows(lines: list[str], lineno: int, code: str) -> bool:
-    """flake8 noqa scoping: a bare ``# noqa`` suppresses everything,
-    ``# noqa: CODE[,CODE]`` only the named codes."""
-    if not (0 < lineno <= len(lines)):
-        return False
-    m = re.search(r"#\s*noqa(?::\s*([A-Z0-9, ]+))?", lines[lineno - 1])
-    return bool(m and (m.group(1) is None or code in m.group(1)))
+pitfalls = _load_pitfalls()
 
 
-def iter_py_files(root: str = REPO):
-    for dirpath, dirnames, filenames in os.walk(root):
-        dirnames[:] = [d for d in dirnames if d not in SKIP_DIRS]
-        for name in filenames:
-            if name.endswith(".py"):
-                yield os.path.join(dirpath, name)
+# File walk + skip set shared with the analysis CLI (one table, one
+# file set — see pitfalls.SKIP_DIRS).
+iter_py_files = pitfalls.iter_py_files
 
 
 def _import_group(module: str) -> int:
@@ -177,68 +152,9 @@ def check_file(path: str) -> list[str]:
                     f"{rel}:{lineno}: F401 '{name}' imported but "
                     "unused")
 
-    # DTT001: bare jsonl emission. Flag write-mode open() calls whose
-    # file argument mentions "jsonl" outside the sink modules — all
-    # event emission must go through telemetry/events.py or host
-    # tagging (and with it multi-host aggregation) silently breaks.
-    # tests/ hand-writes fixture streams by design; derived artifacts
-    # opt out with an inline `# noqa`.
-    if rel not in JSONL_SINKS and not rel.startswith("tests" + os.sep):
-        for node in ast.walk(tree):
-            if not (isinstance(node, ast.Call)
-                    and isinstance(node.func, ast.Name)
-                    and node.func.id == "open" and node.args):
-                continue
-            mode = node.args[1] if len(node.args) >= 2 else None
-            for kw in node.keywords:
-                if kw.arg == "mode":
-                    mode = kw.value
-            if not (isinstance(mode, ast.Constant)
-                    and isinstance(mode.value, str)
-                    and set(mode.value) & _WRITE_CHARS):
-                continue
-            target = ast.get_source_segment(text, node.args[0]) or ""
-            if "jsonl" not in target.lower():
-                continue
-            # flake8 noqa semantics: a bare `# noqa` suppresses
-            # everything, `# noqa: CODE[,CODE]` only the named codes —
-            # an unrelated `# noqa: E501` must not disable this rule.
-            if _noqa_allows(lines, node.lineno, "DTT001"):
-                continue
-            problems.append(
-                f"{rel}:{node.lineno}: DTT001 write-mode open() of a "
-                "jsonl stream outside the telemetry sink — emit "
-                "through telemetry/events.py (host tagging)")
-
-    # DTT002: broad silent swallow. `except Exception: pass` (or bare
-    # except / BaseException) discards failure evidence — in a
-    # codebase whose failure model is crash-restart-resume, that is
-    # how recovery bugs hide. Either narrow the exception, log a
-    # breadcrumb, or justify with `# noqa: DTT002` on the except line.
-    if rel not in DTT002_ALLOWLIST:
-        for node in ast.walk(tree):
-            if not isinstance(node, ast.ExceptHandler):
-                continue
-            if not all(isinstance(s, ast.Pass) for s in node.body):
-                continue
-            t = node.type
-            names = []
-            if t is None:
-                names = ["<bare>"]
-            elif isinstance(t, ast.Name):
-                names = [t.id]
-            elif isinstance(t, ast.Tuple):
-                names = [e.id for e in t.elts
-                         if isinstance(e, ast.Name)]
-            if not any(n == "<bare>" or n in _BROAD_EXC_NAMES
-                       for n in names):
-                continue
-            if _noqa_allows(lines, node.lineno, "DTT002"):
-                continue
-            problems.append(
-                f"{rel}:{node.lineno}: DTT002 silent broad exception "
-                "swallow (`except Exception: pass`) — narrow it, log "
-                "a breadcrumb, or noqa with justification")
+    # Repo rules DTT001–DTT006: the shared registry (parse reused).
+    problems += pitfalls.check_file_rules(path, repo=REPO, text=text,
+                                          tree=tree)
 
     # isort subset (default/black-profile semantics): sections ordered
     # future < stdlib < third-party < first-party < relative; within a
