@@ -34,6 +34,11 @@ Rule catalog (details in docs/static-analysis.md):
   ``jax.random.split``/``fold_in`` silently repeats randomness.
 - DTT006 jitted train-step without buffer donation: params/opt-state
   double-buffer in HBM, halving the usable memory budget.
+- DTT007 hard-coded world size: comparing ``process_count``-like
+  values against literals >= 2, or iterating ``range(<literal>)``
+  over hosts/shards, in trainer/data/telemetry hot paths — elastic
+  runs (resilience/elastic.py) resize the world mid-run, and these
+  literals break silently at any other size.
 """
 
 from __future__ import annotations
@@ -464,6 +469,34 @@ def _check_key_reuse(ctx: FileContext):
 _STEP_NAME = re.compile(r"(^|_)(train_?)?step(_?fn)?$", re.IGNORECASE)
 
 
+# ---------------------------------------------------------------------------
+# DTT007 — hard-coded world size in elastic hot paths
+# ---------------------------------------------------------------------------
+
+# Identifiers that carry a world-ish cardinality. Comparisons against
+# literals >= 2 bake a topology in; 0/1 are the world-size-agnostic
+# single-process / coordinator checks.
+_DTT007_WORLD_NAMES = {
+    "process_count", "num_processes", "world_size", "num_hosts",
+    "host_count", "num_shards", "data_shard_count", "shard_count",
+    "nproc",
+}
+# Paths (relative to the repo root) where the rule applies: the code
+# an elastic resize actually flows through. Benchmarks/tools may pin
+# worlds deliberately.
+DTT007_SCOPED = (
+    os.path.join("distributed_training_tpu", "train"),
+    os.path.join("distributed_training_tpu", "data"),
+    os.path.join("distributed_training_tpu", "telemetry"),
+)
+# Word-segment match for host/shard-indexed state in a range-loop
+# body: ``host_dirs``/``per_host``/``shard``/``shards`` hit;
+# ``subprocess``/``multiprocessing`` (substring "process") and other
+# incidental names do not — a literal-bounded RETRY loop is not a
+# world-size pin.
+_DTT007_BODY_RE = re.compile(r"(^|_)(hosts?|shards?)(_|$)")
+
+
 def _dtt006_step_like(ctx: FileContext, call: ast.Call) -> str:
     """Why this ``jax.jit`` call looks like a train step ('' if not):
     the jitted function's name, or the assignment target's name,
@@ -487,6 +520,57 @@ def _dtt006_step_like(ctx: FileContext, call: ast.Call) -> str:
 def _donates(call: ast.Call) -> bool:
     return any(kw.arg in ("donate_argnums", "donate_argnames")
                for kw in call.keywords)
+
+
+@_rule("DTT007", "hard-coded-world-size",
+       "world-size/process-count literal in an elastic hot path")
+def _check_world_size_literal(ctx: FileContext):
+    """``process_count == 2`` / ``num_shards >= 4`` /
+    ``for h in range(4): ... host_dirs[h] ...`` bake one world size
+    into code the elastic supervisor re-forms at ANOTHER size —
+    nothing crashes, the logic is just silently wrong at 3 hosts.
+    Comparisons against 0/1 stay legal (the single-process check and
+    coordinator gating are world-size-agnostic). Scoped to the
+    trainer/data/telemetry hot paths (DTT007_SCOPED); one-off scripts
+    and benchmarks may pin worlds deliberately."""
+    if not any(ctx.rel.startswith(p + os.sep) or ctx.rel == p
+               for p in DTT007_SCOPED):
+        return
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Compare):
+            sides = [node.left] + list(node.comparators)
+            names = {_terminal_name(s.func) if isinstance(s, ast.Call)
+                     else _terminal_name(s) for s in sides}
+            lits = [s.value for s in sides
+                    if isinstance(s, ast.Constant)
+                    and isinstance(s.value, int)
+                    and not isinstance(s.value, bool)]
+            world = names & _DTT007_WORLD_NAMES
+            if world and any(v >= 2 for v in lits):
+                yield (node.lineno,
+                       f"`{sorted(world)[0]}` compared against a "
+                       "world-size literal — elastic runs resize the "
+                       "world mid-run; derive from the runtime (or "
+                       "noqa a deliberate pin)")
+        elif (isinstance(node, ast.For)
+              and isinstance(node.iter, ast.Call)
+              and _terminal_name(node.iter.func) == "range"
+              and node.iter.args
+              and isinstance(node.iter.args[0], ast.Constant)
+              and isinstance(node.iter.args[0].value, int)
+              and node.iter.args[0].value >= 2
+              and len(node.iter.args) == 1):
+            body_names = set()
+            for stmt in node.body:
+                body_names |= _names_in(stmt)
+            hostish = {n for n in body_names
+                       if _DTT007_BODY_RE.search(n.lower())}
+            if hostish:
+                yield (node.lineno,
+                       f"`range({node.iter.args[0].value})` iterated "
+                       "over host/shard-indexed state "
+                       f"({sorted(hostish)[0]}) — a fixed world size; "
+                       "derive the count from the runtime")
 
 
 @_rule("DTT006", "undonated-train-step",

@@ -41,6 +41,13 @@ class TrainConfig:
     conf/train/default.yaml)."""
 
     batch_size: int = 32          # per-process batch size, as in the reference
+    # Elastic runs: a WORLD-SIZE-INVARIANT global batch. When > 0 the
+    # CLI derives the per-shard batch_size as global_batch_size /
+    # data_shard_count at startup, so a run that shrinks from 4 hosts
+    # to 3 keeps the same optimization trajectory (pick a value
+    # divisible by every world size the run can shrink to, e.g. 12
+    # for 4-or-3). 0 keeps the legacy per-shard batch_size semantics.
+    global_batch_size: int = 0
     total_epochs: int = 10
     save_every: int = 2           # epochs between checkpoints
     snapshot_path: str = "checkpoints"  # absolute-anchored at load (fixes B2)
@@ -96,6 +103,13 @@ class TrainConfig:
     # noise — host GC, a checkpoint drain; a persistent 2x is a
     # failing host).
     straggler_persist: int = 2
+    # Consecutive flagged windows before the detector requests a
+    # COORDINATED EVICTION of the worst host: every host (same
+    # all-gathered table, same step) breaks its loop, saves, and exits
+    # with a host_lost sentinel the elastic supervisor consumes —
+    # never an in-band kill. 0 disables (verdicts stay advisory).
+    # Meaningful under launch.local --supervise --elastic.
+    straggler_evict_after: int = 0
     # One-shot static audit of the compiled step's collective traffic
     # (telemetry/collectives.py): after the first step the coordinator
     # lowers+compiles the same program device-less and emits a

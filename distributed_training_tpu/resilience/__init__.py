@@ -21,7 +21,15 @@ restart), and utils/preemption.py already covers the COOPERATIVE half
   (``train.fault_plan="crash@40,sigterm@80,..."``), every trigger a
   pure function of the global step (the straggler.py discipline:
   multi-host injection cannot deadlock), which is what makes the two
-  pillars above testable end-to-end on CPU.
+  pillars above testable end-to-end on CPU. ``lose_host@N:host=K`` /
+  ``slow_host@N:host=K:200ms`` drive the elastic paths.
+- ``elastic.py`` — the shrink/grow world-size policy
+  (``launch.local --supervise --elastic``): on a lost or evicted
+  host, checkpoint, re-form the mesh at the surviving world size
+  (resharded restore; per-host batch rescaled to preserve the global
+  batch), continue, and grow back at a checkpoint boundary when
+  capacity returns. Straggler verdicts (telemetry/straggler.py)
+  escalate to coordinated evictions through the same path.
 
 This ``__init__`` is deliberately import-free: the supervisor runs in
 the LAUNCHER parent process and must not drag in orbax or the

@@ -12,7 +12,8 @@ Grammar (docs/robustness.md)::
     plan    := entry ("," entry)*
     entry   := kind "@" step (":" modifier)*
     kind    := crash | sigterm | corrupt_ckpt | data_stall | data_error
-    modifier:= "always" | duration          # duration: "500ms" | "2s"
+             | lose_host | slow_host
+    modifier:= "always" | duration | "host=" K   # duration: "500ms"
 
 - ``crash@40``        raise ``InjectedCrash`` after step 40 completes
   (hard failure: no final save; recovery = supervisor restart +
@@ -26,6 +27,21 @@ Grammar (docs/robustness.md)::
   (exercises data_wait accounting and the hang watchdog).
 - ``data_error@60``   raise a transient ``InjectedDataError`` in batch
   assembly at step 60 (exercises the loader's bounded retry).
+- ``lose_host@40:host=2`` host 2 dies WITHOUT CLEANUP
+  (``os._exit``) after step 40 — the machine-reclaimed shape; no
+  sentinel, no final save. Exercises the launcher's lost-host
+  detection and the elastic shrink path (resilience/elastic.py).
+- ``slow_host@40:host=2:200ms`` host 2 sleeps 200ms inside EVERY
+  measured step from step 40 on — a persistently degraded host, not a
+  blip. Exercises the straggler detector's verdict → coordinated
+  eviction path. Unlike the one-shot faults it keeps applying for the
+  rest of its incarnation; the ledger only suppresses it after a
+  restart (the degraded host was evicted — its replacement at the
+  same index must not inherit the slowdown).
+
+Host-targeted faults keep the every-host-same-loop-point discipline:
+every host evaluates the trigger; only the host whose process index
+matches ``host=K`` acts, and the action never involves a collective.
 
 **One-shot vs. always:** a restarted run re-executes the steps since
 the last checkpoint, so a naive step trigger re-fires every
@@ -50,13 +66,20 @@ import signal
 import time
 from dataclasses import dataclass
 
+from distributed_training_tpu.resilience.elastic import (
+    LOST_HOST_EXIT_CODE)
+
 logger = logging.getLogger(__name__)
 
-KINDS = ("crash", "sigterm", "corrupt_ckpt", "data_stall", "data_error")
+KINDS = ("crash", "sigterm", "corrupt_ckpt", "data_stall", "data_error",
+         "lose_host", "slow_host")
+# Kinds that target one host (require a host= modifier).
+HOST_KINDS = ("lose_host", "slow_host")
 
 _ENTRY_RE = re.compile(r"^(?P<kind>[a-z_]+)@(?P<step>\d+)"
-                       r"(?P<mods>(?::[A-Za-z0-9.]+)*)$")
+                       r"(?P<mods>(?::[A-Za-z0-9.=]+)*)$")
 _DURATION_RE = re.compile(r"^(?P<num>\d+(?:\.\d+)?)(?P<unit>ms|s)$")
+_HOST_RE = re.compile(r"^host=(?P<host>\d+)$")
 
 
 class FaultPlanError(ValueError):
@@ -90,12 +113,15 @@ class Fault:
     step: int
     always: bool = False
     stall_s: float = 0.0
+    host: int | None = None
 
     @property
     def key(self) -> str:
-        """Ledger identity. Deliberately excludes modifiers: the plan
-        is config, the (kind, step) pair is the scheduled incident."""
-        return f"{self.kind}@{self.step}"
+        """Ledger identity. Deliberately excludes tuning modifiers:
+        the plan is config, the (kind, step[, host]) tuple is the
+        scheduled incident."""
+        base = f"{self.kind}@{self.step}"
+        return base if self.host is None else f"{base}:host={self.host}"
 
 
 def parse_fault_plan(spec: str) -> tuple[Fault, ...]:
@@ -122,21 +148,34 @@ def parse_fault_plan(spec: str) -> tuple[Fault, ...]:
                 f"fault step must be >= 1 in {entry!r}")
         always = False
         stall_s = 0.0
+        host: int | None = None
         mods = [t for t in m.group("mods").split(":") if t]
         for tok in mods:
+            hm = _HOST_RE.match(tok)
             if tok == "always":
                 always = True
+            elif hm:
+                host = int(hm.group("host"))
             else:
                 stall_s = parse_duration_s(tok)
-        if stall_s and kind != "data_stall":
+        if stall_s and kind not in ("data_stall", "slow_host"):
             raise FaultPlanError(
-                f"duration modifier only applies to data_stall, "
-                f"got {entry!r}")
-        if kind == "data_stall" and not stall_s:
+                f"duration modifier only applies to data_stall/"
+                f"slow_host, got {entry!r}")
+        if kind in ("data_stall", "slow_host") and not stall_s:
             raise FaultPlanError(
-                f"data_stall needs a duration, e.g. "
-                f"'data_stall@{step}:500ms' (got {entry!r})")
-        f = Fault(kind=kind, step=step, always=always, stall_s=stall_s)
+                f"{kind} needs a duration, e.g. "
+                f"'{kind}@{step}:500ms' (got {entry!r})")
+        if host is not None and kind not in HOST_KINDS:
+            raise FaultPlanError(
+                f"host= modifier only applies to "
+                f"{'/'.join(HOST_KINDS)}, got {entry!r}")
+        if kind in HOST_KINDS and host is None:
+            raise FaultPlanError(
+                f"{kind} needs a target, e.g. "
+                f"'{kind}@{step}:host=2' (got {entry!r})")
+        f = Fault(kind=kind, step=step, always=always, stall_s=stall_s,
+                  host=host)
         if f.key in seen:
             raise FaultPlanError(f"duplicate fault {f.key!r}")
         seen.add(f.key)
@@ -170,15 +209,19 @@ class FaultInjector:
 
     ``ledger_path`` holds the fired-set across restarts (one file per
     host — each host fires deterministically and records its own).
-    ``ckpt_dir`` is where ``corrupt_ckpt`` finds its victim."""
+    ``ckpt_dir`` is where ``corrupt_ckpt`` finds its victim. ``host``
+    is this process's index — host-targeted faults (``host=K``) act
+    only when it matches, though every host evaluates the trigger."""
 
     def __init__(self, plan: tuple[Fault, ...] | str,
                  ledger_path: str | None = None,
-                 ckpt_dir: str | None = None):
+                 ckpt_dir: str | None = None,
+                 host: int = 0):
         self.plan = (parse_fault_plan(plan) if isinstance(plan, str)
                      else tuple(plan))
         self.ledger_path = ledger_path
         self.ckpt_dir = ckpt_dir
+        self.host = int(host)
         self.fired: set[str] = set()
         if ledger_path and os.path.exists(ledger_path):
             try:
@@ -188,6 +231,12 @@ class FaultInjector:
                 logger.warning("unreadable fault ledger %s (%s); "
                                "treating all faults as unfired",
                                ledger_path, e)
+        # Snapshot of what had fired BEFORE this incarnation started:
+        # ``slow_host`` keeps applying within the incarnation that
+        # first fired it (a degraded host stays degraded) but must not
+        # resume after a restart — the evicted host's replacement at
+        # the same index is a healthy machine.
+        self._fired_at_load: set[str] = set(self.fired)
         if self.plan:
             logger.info(
                 "fault plan armed: %s (already fired: %s)",
@@ -226,14 +275,46 @@ class FaultInjector:
     def on_step(self, global_step: int) -> None:
         """Trainer step loop, after step ``global_step``'s bookkeeping.
         Graceful faults fire before lethal ones so a plan scheduling
-        both at one step still exercises the graceful path."""
+        both at one step still exercises the graceful path; the
+        host-targeted ``lose_host`` fires between them (it is lethal,
+        but only for its target — the survivors' next collective hangs
+        until the launcher's fail-fast sweep reaps the group, exactly
+        the real lost-host shape)."""
         for f in self._due(global_step, ("sigterm",)):
             self._record(f)
             signal.raise_signal(signal.SIGTERM)
+        for f in self._due(global_step, ("lose_host",)):
+            if f.host != self.host:
+                continue  # every host evaluates; only the target acts
+            self._record(f, host=self.host)
+            logger.warning("lose_host: host %d dying without cleanup "
+                           "(os._exit(%d))", self.host,
+                           LOST_HOST_EXIT_CODE)
+            os._exit(LOST_HOST_EXIT_CODE)
         for f in self._due(global_step, ("crash",)):
             self._record(f)
             raise InjectedCrash(
                 f"injected crash at global step {global_step}")
+
+    def step_delay(self, global_step: int) -> float:
+        """Seconds this host must stall inside the measured region of
+        step ``global_step`` (``slow_host`` faults). Applies to EVERY
+        step >= the trigger step for the rest of the incarnation —
+        a degraded host, not a blip — and is recorded (ledger +
+        telemetry) once, at first application. Skipped entirely when
+        a previous incarnation already fired it (the slow host was
+        evicted; its replacement is healthy)."""
+        total = 0.0
+        for f in self.plan:
+            if (f.kind != "slow_host" or global_step < f.step
+                    or f.host != self.host):
+                continue
+            if not f.always and f.key in self._fired_at_load:
+                continue
+            if f.key not in self.fired:
+                self._record(f, host=self.host, stall_s=f.stall_s)
+            total += f.stall_s
+        return total
 
     def on_data(self, step: int) -> None:
         """Data path, once per batch assembly ATTEMPT (inside the

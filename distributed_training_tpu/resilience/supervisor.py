@@ -45,6 +45,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Callable
 
+from distributed_training_tpu.resilience import elastic as elastic_mod
 from distributed_training_tpu.resilience.integrity import (
     checkpoint_steps_on_disk)
 
@@ -53,6 +54,12 @@ logger = logging.getLogger(__name__)
 # Exit outcomes, worst-first. Sentinel files carry these in "outcome".
 COMPLETED = "completed"
 PREEMPTED = "preempted"
+# One (or a strict subset) of the group's hosts was lost — evicted by
+# a straggler verdict (clean exits + host_lost sentinels naming the
+# evictee) or reclaimed/crashed under the survivors (launcher group
+# report). Under an elastic policy this is the shrink trigger; without
+# one it degrades to the crash/preempted budget rules.
+HOST_LOST = "host_lost"
 WATCHDOG_ABORT = "watchdog_abort"
 CRASH = "crash"
 
@@ -121,6 +128,11 @@ def classify_exit(returncode: int, statuses: list[dict]) -> str:
     outcomes = {s.get("outcome") for s in statuses}
     if WATCHDOG_ABORT in outcomes or returncode == WATCHDOG_EXIT_CODE:
         return WATCHDOG_ABORT
+    if HOST_LOST in outcomes:
+        # A coordinated eviction exits CLEANLY (every host saves and
+        # writes the sentinel naming the evictee) — only the sentinel
+        # distinguishes it from completion/preemption.
+        return HOST_LOST
     if returncode == 0:
         return PREEMPTED if PREEMPTED in outcomes else COMPLETED
     # 143/130: death by SIGTERM/SIGINT (launch.wait encodes signal
@@ -164,7 +176,11 @@ class RestartPolicy:
 
 @dataclass
 class Incident:
-    """One supervised incarnation's outcome (the give-up summary)."""
+    """One supervised incarnation's outcome (the give-up summary).
+    ``world_size``/``evicted`` record the topology the incarnation ran
+    at (elastic runs; postmortems want the history), ``lost_hosts``
+    which hosts it lost, ``elastic_action`` what the policy decided
+    for the NEXT incarnation ("retry"/"shrink"/"grow")."""
 
     incarnation: int
     returncode: int
@@ -174,6 +190,10 @@ class Incident:
     advanced: bool
     budget_after: int = 0
     backoff_s: float = 0.0
+    world_size: int | None = None
+    evicted: list[int] = field(default_factory=list)
+    lost_hosts: list[int] = field(default_factory=list)
+    elastic_action: str | None = None
 
 
 @dataclass
@@ -194,7 +214,13 @@ class SuperviseResult:
                 f"  #{inc.incarnation}: {inc.outcome} rc={inc.returncode}"
                 f" wall={inc.wall_s:.1f}s ckpt_step={inc.ckpt_step}"
                 f"{' (advanced)' if inc.advanced else ''}"
-                f" budget={inc.budget_after}")
+                f" budget={inc.budget_after}"
+                + (f" world={inc.world_size}"
+                   if inc.world_size is not None else "")
+                + (f" lost={inc.lost_hosts}" if inc.lost_hosts else "")
+                + (f" -> {inc.elastic_action}"
+                   if inc.elastic_action
+                   and inc.elastic_action != "retry" else ""))
         return lines
 
 
@@ -203,7 +229,7 @@ class SuperviseResult:
 # ---------------------------------------------------------------------------
 
 
-def supervise(run_incarnation: Callable[[dict[str, str]], int],
+def supervise(run_incarnation: Callable[[dict[str, str]], object],
               *,
               policy: RestartPolicy | None = None,
               state_dir: str,
@@ -211,6 +237,8 @@ def supervise(run_incarnation: Callable[[dict[str, str]], int],
               telemetry=None,
               sleep: Callable[[float], None] = time.sleep,
               should_stop: Callable[[], bool] | None = None,
+              elastic: "elastic_mod.ElasticPolicy | None" = None,
+              on_incident: Callable[[Incident], None] | None = None,
               ) -> SuperviseResult:
     """Run ``run_incarnation(extra_env)`` until completion or budget
     exhaustion; returns the final rc plus the incident log.
@@ -218,21 +246,45 @@ def supervise(run_incarnation: Callable[[dict[str, str]], int],
     ``run_incarnation`` launches ONE incarnation of the training job
     (all its processes) with the given extra environment merged in,
     blocks, and returns the group's exit code — for the local
-    launcher that is ``launch_local(...)`` + ``wait(...)``.
+    launcher that is ``launch_local(...)`` + ``wait(...)``. It may
+    instead return an ``elastic.GroupReport`` (the launcher's
+    ``wait_report``); the per-process detail is what lets an elastic
+    policy tell "host 2 died" from "everything died".
 
     ``ckpt_dir`` enables progress-based budget refunds; without it
     every non-completed exit burns budget (strictly bounded either
     way). ``telemetry`` (an events.Telemetry or None) records one
-    ``restart`` event per relaunch and a ``supervisor_give_up`` event
-    on budget exhaustion. ``should_stop`` (checked between
-    incarnations) lets the caller end supervision from the outside —
-    the launcher's own preemption path."""
+    ``restart`` event per relaunch, an ``elastic`` event per world
+    resize, and a ``supervisor_give_up`` event on budget exhaustion.
+    ``should_stop`` (checked between incarnations) lets the caller end
+    supervision from the outside — the launcher's own preemption path.
+
+    ``elastic`` (an ``elastic.ElasticPolicy``) turns host losses into
+    world resizes instead of fixed-size retries: the next incarnation's
+    world size and evicted-host set ride the env
+    (``DTT_ELASTIC_WORLD`` / ``DTT_ELASTIC_EVICTED``); a successful
+    shrink or grow refunds the budget and resets the backoff (the
+    reconfiguration IS the recovery). ``on_incident`` is called with
+    each finalized Incident — the launcher writes per-attempt
+    summaries from it."""
     policy = policy or RestartPolicy()
     os.makedirs(state_dir, exist_ok=True)
     result = SuperviseResult(returncode=0)
     budget = policy.max_restarts
     streak = 0  # consecutive failures without checkpoint progress
     incarnation = 0
+    estate = (elastic_mod.ElasticState(world=elastic.base_world)
+              if elastic is not None else None)
+    elastic_dir = os.path.join(state_dir, "elastic")
+
+    def _notify(incident: Incident) -> None:
+        if on_incident is not None:
+            try:
+                on_incident(incident)
+            except Exception:  # noqa: BLE001 — a summary-writing
+                # callback must never take down the restart loop.
+                logger.exception("on_incident callback failed")
+
     while True:
         base = os.path.join(state_dir, f"exit_{incarnation}")
         # A previous supervisor run in the same state_dir (log dirs
@@ -247,12 +299,38 @@ def supervise(run_incarnation: Callable[[dict[str, str]], int],
                 pass
         env = {ENV_SENTINEL: base,
                ENV_RESTART_COUNT: str(incarnation)}
+        if estate is not None:
+            # Stale requests from a previous incarnation (or a previous
+            # supervisor run) must not evict a healthy host now.
+            elastic_mod.clear_eviction_request(elastic_dir)
+            env[elastic_mod.ENV_WORLD] = str(estate.world)
+            env[elastic_mod.ENV_EVICTED] = ",".join(
+                map(str, estate.evicted))
+            env[elastic_mod.ENV_ELASTIC_DIR] = elastic_dir
+            if estate.world < elastic.base_world and elastic.grow:
+                # Arm the launcher's grow watcher: once the reduced
+                # world has committed this many NEW checkpoints (and
+                # capacity holds), it signals the incarnation down at
+                # that checkpoint boundary for the grow-back relaunch.
+                env[elastic_mod.ENV_GROW_AFTER_CKPTS] = str(
+                    elastic.required_ckpts_before_grow(estate.flaps))
         pre_steps = (set(checkpoint_steps_on_disk(ckpt_dir))
                      if ckpt_dir else set())
         t0 = time.monotonic()
-        rc = run_incarnation(env)
+        raw = run_incarnation(env)
         wall = time.monotonic() - t0
-        outcome = classify_exit(rc, read_exit_statuses(base))
+        report = (raw if isinstance(raw, elastic_mod.GroupReport)
+                  else elastic_mod.GroupReport(returncode=int(raw)))
+        rc = report.returncode
+        statuses = read_exit_statuses(base)
+        outcome = classify_exit(rc, statuses)
+        lost: list[int] = []
+        lost_reason = None
+        if estate is not None and outcome != COMPLETED:
+            lost, lost_reason = elastic_mod.lost_hosts_of(
+                report, statuses, elastic_dir)
+            if lost:
+                outcome = HOST_LOST
         post_steps = (set(checkpoint_steps_on_disk(ckpt_dir))
                       if ckpt_dir else set())
         step = max(post_steps) if post_steps else None
@@ -265,13 +343,19 @@ def supervise(run_incarnation: Callable[[dict[str, str]], int],
         advanced = bool(post_steps - pre_steps)
         incident = Incident(incarnation=incarnation, returncode=rc,
                             outcome=outcome, wall_s=wall,
-                            ckpt_step=step, advanced=advanced)
+                            ckpt_step=step, advanced=advanced,
+                            world_size=(estate.world if estate
+                                        else report.world_size),
+                            evicted=(list(estate.evicted) if estate
+                                     else []),
+                            lost_hosts=list(lost))
         result.incidents.append(incident)
         if outcome == COMPLETED:
             incident.budget_after = budget
             result.returncode = 0
             for line in result.summary_lines():
                 logger.info("%s", line)
+            _notify(incident)
             return result
         if should_stop is not None and should_stop():
             # The SUPERVISOR was told to stop (e.g. the launcher was
@@ -283,19 +367,51 @@ def supervise(run_incarnation: Callable[[dict[str, str]], int],
             logger.warning("supervisor: stop requested; not "
                            "restarting (last outcome %s rc=%d)",
                            outcome, rc)
+            _notify(incident)
             return result
+        decision = None
+        if estate is not None:
+            old_world = estate.world
+            decision = elastic.decide_after_exit(
+                estate, outcome, lost, lost_reason,
+                new_ckpts=len(post_steps - pre_steps),
+                grow_requested=report.grow_requested)
+            incident.elastic_action = decision.action
+            if decision.action != "retry":
+                logger.warning(
+                    "supervisor: elastic %s — world %d -> %d%s",
+                    decision.action, old_world, estate.world,
+                    f" (evicted {sorted(estate.evicted)})"
+                    if estate.evicted else "")
+                if telemetry is not None:
+                    telemetry.event(
+                        "elastic", incarnation=incarnation,
+                        action=decision.action, old_world=old_world,
+                        new_world=estate.world,
+                        lost_hosts=list(lost), lost_reason=lost_reason,
+                        evicted=list(estate.evicted), outcome=outcome,
+                        ckpt_step=step)
         # Budget: checkpoint progress (or a clean preemption, which is
         # the infrastructure's fault, not the job's) refunds; anything
         # else burns. This is what turns a deterministic step-N crash
-        # into a fast, bounded give-up (see module docstring).
-        if advanced:
+        # into a fast, bounded give-up (see module docstring). A
+        # successful elastic shrink/grow also refunds AND resets the
+        # backoff streak: the failure was answered by reconfiguration,
+        # so the relaunch is immediate.
+        if decision is not None and decision.refund:
             budget = policy.max_restarts
             streak = 0
-        elif outcome == PREEMPTED:
+        elif advanced:
+            budget = policy.max_restarts
+            streak = 0
+        elif outcome in (PREEMPTED, HOST_LOST):
             # Refund the budget (not the job's fault) but KEEP the
             # backoff escalating: a preemption storm with zero
             # checkpoint progress must wait out the capped backoff
-            # between attempts, never hot-loop restarts.
+            # between attempts, never hot-loop restarts. A host loss
+            # the policy chose NOT to shrink on (replacement capacity,
+            # min_world floor) is the same infrastructure-shaped
+            # failure.
             budget = policy.max_restarts
             streak += 1
         else:
@@ -316,6 +432,7 @@ def supervise(run_incarnation: Callable[[dict[str, str]], int],
                                 incarnations=len(result.incidents),
                                 streak=streak, outcome=outcome,
                                 returncode=rc)
+            _notify(incident)
             return result
         delay = policy.backoff_s(streak) if streak else 0.0
         incident.backoff_s = delay
@@ -327,10 +444,18 @@ def supervise(run_incarnation: Callable[[dict[str, str]], int],
             " (advanced)" if advanced else "", delay, budget,
             policy.max_restarts)
         if telemetry is not None:
+            extra = {}
+            if incident.world_size is not None:
+                # Topology history for postmortems: the size this
+                # incarnation ran at and who was excluded from it.
+                extra = {"world_size": incident.world_size,
+                         "evicted_hosts": list(incident.evicted)}
             telemetry.event("restart", incarnation=incarnation,
                             outcome=outcome, returncode=rc,
                             ckpt_step=step, advanced=advanced,
-                            backoff_s=round(delay, 3), budget=budget)
+                            backoff_s=round(delay, 3), budget=budget,
+                            **extra)
+        _notify(incident)
         if delay > 0:
             sleep(delay)
         incarnation += 1

@@ -40,8 +40,8 @@ import numpy as np
 from distributed_training_tpu.telemetry import collectives as collectives_lib
 from distributed_training_tpu.telemetry.goodput import goodput_of_stream
 from distributed_training_tpu.telemetry.straggler import flag_stragglers
-from distributed_training_tpu.telemetry.summarize import (load_jsonl,
-                                                          _loss_stats)
+from distributed_training_tpu.telemetry.summarize import (
+    _loss_stats, _recovery, load_jsonl, render_recovery_lines)
 
 # Bump when the aggregate summary's keys change meaning.
 SCHEMA = 1
@@ -270,6 +270,14 @@ def aggregate_run(run_dir: str, threshold: float | None = None) -> dict:
                              if runtime_events else None),
         },
         "collectives": coll,
+        # Recovery/elastic accounting from the COORDINATOR's stream:
+        # every host appends its own run_start/resume per incarnation,
+        # so segmenting the merged timeline would count one restart N
+        # times. Host 0 always exists (process indices refill after an
+        # elastic shrink) and tells the one canonical story. Additive
+        # key — SCHEMA stays 1 (pinned by test).
+        "recovery": _recovery(
+            min(streams.items())[1] if streams else []),
         "watchdog_firings": [e for e in merged
                              if e.get("kind") == "watchdog_fired"],
         "postmortems": postmortems,
@@ -347,6 +355,9 @@ def render_multihost(summary: dict) -> str:
     coll = summary.get("collectives")
     if coll:
         lines.extend(collectives_lib.render_lines(coll))
+    rec = summary.get("recovery")
+    if rec:
+        lines.extend(render_recovery_lines(rec))
     for w in summary.get("watchdog_firings", []):
         lines.append(f"WATCHDOG FIRED on host {w.get('host', '?')}: "
                      f"{w.get('postmortem')}")

@@ -95,11 +95,24 @@ class StragglerDetector:
 
     def __init__(self, runtime, telemetry=None, every: int = 0,
                  threshold: float = 1.5, persist: int = 2,
-                 min_gap_s: float = 0.005, gather=None):
+                 min_gap_s: float = 0.005, gather=None,
+                 evict_after: int = 0, elastic_dir: str | None = None):
         self.every = int(every)
         self.threshold = threshold
         self.persist = max(1, int(persist))
         self.min_gap_s = min_gap_s
+        # Consecutive flagged windows before a verdict escalates to a
+        # COORDINATED eviction request (0 = verdicts stay advisory).
+        # The decision is computed from the all-gathered table, so it
+        # lands on every host at the same exchange step — each host
+        # breaks its loop at the same point and no one is stranded in
+        # a collective (the cadence discipline, extended to teardown).
+        self.evict_after = max(0, int(evict_after))
+        # Where the coordinator writes the eviction-request sentinel
+        # the elastic supervisor consumes (resilience/elastic.py);
+        # exits carry the verdict too, via host_lost exit sentinels.
+        self.elastic_dir = elastic_dir
+        self.evict_request: dict | None = None
         self.process_index = runtime.process_index
         self.process_count = runtime.process_count
         self.enabled = self.every > 0 and self.process_count > 1
@@ -173,9 +186,46 @@ class StragglerDetector:
             "verdicts": verdicts,
             "persistent": [v["text"] for v in persistent],
         }
+        self._maybe_request_eviction(global_step, verdicts)
+        if self.evict_request is not None:
+            summary["eviction"] = self.evict_request
         self.last = summary
         self.telemetry.event("straggler", **summary)
         return summary
+
+    def _maybe_request_eviction(self, global_step: int,
+                                verdicts: list[dict]) -> None:
+        """Escalate a long-persistent verdict into an eviction request.
+        Streaks are derived from the shared gathered table, so every
+        host reaches the same conclusion at the same step; the
+        request itself is a flag the trainer polls (coordinated clean
+        stop) plus a coordinator-written sentinel FILE for the
+        supervisor — never a kill."""
+        if not self.evict_after or self.evict_request is not None:
+            return
+        worst = next(
+            (v for v in verdicts  # verdicts arrive worst-first
+             if self._streaks.get((v["host"], v["metric"]), 0)
+             >= self.evict_after), None)
+        if worst is None:
+            return
+        self.evict_request = {
+            "host": int(worst["host"]), "step": global_step,
+            "metric": worst["metric"], "ratio": worst["ratio"],
+            "reason": "straggler",
+        }
+        logger.warning(
+            "eviction requested: host %d is %.1fx median on %s for "
+            ">= %d windows — coordinated stop for elastic "
+            "reconfiguration", worst["host"], worst["ratio"],
+            worst["metric"], self.evict_after)
+        self.telemetry.event("eviction_request", **self.evict_request)
+        if self.process_index == 0 and self.elastic_dir:
+            # Filesystem-only and idempotent — safe to gate by host
+            # (no collective behind this guard).
+            from distributed_training_tpu.resilience import elastic
+            elastic.write_eviction_request(self.elastic_dir,
+                                           **self.evict_request)
 
     def watchdog_info(self) -> dict:
         """Context for HangWatchdog.set_context: the latest persistent

@@ -109,27 +109,45 @@ def _hbm(events: list[dict]) -> dict | None:
     return out
 
 
+def _segment_world(seg: dict) -> int | None:
+    """World size a segment ran at: the resume event's ``world_size``
+    (elastic-aware incarnations) or the segment's ``clock_sync``
+    ``process_count`` (every incarnation emits one at setup)."""
+    resume = seg.get("resume") or {}
+    if isinstance(resume.get("world_size"), int):
+        return resume["world_size"]
+    if isinstance(seg.get("process_count"), int):
+        return seg["process_count"]
+    return None
+
+
 def _recovery(events: list[dict]) -> dict | None:
     """Recovery table (docs/robustness.md): every restart appends a
     new ``run_start`` marker to the same stream, so incidents are the
     segment boundaries — time-to-recover is the gap between a
     segment's last record and the next ``run_start``, and steps lost
     is the crashed segment's high-water step minus the step the next
-    incarnation resumed from. Quarantines, injected faults, and data
-    retries ride along. None when the run had nothing to recover
-    from (the common case — the section stays out of the report)."""
+    incarnation resumed from. Quarantines, injected faults, data
+    retries, and elastic world resizes (an incarnation resuming at a
+    different world size than its predecessor ran at) ride along.
+    None when the run had nothing to recover from (the common case —
+    the section stays out of the report)."""
     segments: list[dict] = []
     for e in events:
         t = e.get("t")
         if e.get("kind") == "run_start" or not segments:
             segments.append({"t_start": t, "t_last": t,
                              "start_step": e.get("step"),
-                             "max_step": None, "resume": None})
+                             "max_step": None, "resume": None,
+                             "process_count": None})
         seg = segments[-1]
         if isinstance(t, (int, float)):
             seg["t_last"] = max(seg["t_last"] or t, t)
         if e.get("kind") == "resume" and seg["resume"] is None:
             seg["resume"] = e
+        if (e.get("kind") == "clock_sync"
+                and seg["process_count"] is None):
+            seg["process_count"] = e.get("process_count")
         step = e.get("step")
         if isinstance(step, int):
             seg["max_step"] = max(seg["max_step"] or 0, step)
@@ -149,26 +167,46 @@ def _recovery(events: list[dict]) -> dict | None:
         if (isinstance(prev["t_last"], (int, float))
                 and isinstance(cur["t_start"], (int, float))):
             gap = round(max(0.0, cur["t_start"] - prev["t_last"]), 3)
-        incidents.append({
+        incident = {
             "resumed_at_step": resume_step,
             "prev_max_step": prev["max_step"],
             "steps_lost": lost,
             "time_to_recover_s": gap,
             "restarts": (cur["resume"] or {}).get("restarts"),
-        })
+        }
+        old_w, new_w = _segment_world(prev), _segment_world(cur)
+        if (isinstance(old_w, int) and isinstance(new_w, int)
+                and old_w != new_w):
+            # An elastic resize: the incarnation re-formed at a
+            # different world size (shrink on host loss/eviction,
+            # grow-back at a checkpoint boundary).
+            incident["old_world"] = old_w
+            incident["new_world"] = new_w
+            evicted = (cur["resume"] or {}).get("evicted_hosts")
+            if evicted:
+                incident["evicted_hosts"] = evicted
+        incidents.append(incident)
     quarantined = [e for e in events
                    if e.get("kind") == "ckpt_quarantined"]
     faults = [e for e in events if e.get("kind") == "fault_injected"]
     retries = [e for e in events if e.get("kind") == "data_retry"]
+    evictions = [e for e in events
+                 if e.get("kind") == "eviction_request"]
+    elastic = [i for i in incidents if "new_world" in i]
     if not incidents and not quarantined and not faults \
-            and not retries:
+            and not retries and not evictions:
         return None
     return {
         "restarts": len(incidents),
         "incidents": incidents,
+        "elastic": elastic,
         "quarantined": [{"step": e.get("step"), "path": e.get("path")}
                         for e in quarantined],
         "faults_injected": [e.get("fault") for e in faults],
+        "eviction_requests": [
+            {"host": e.get("host"), "step": e.get("step"),
+             "metric": e.get("metric"), "ratio": e.get("ratio")}
+            for e in evictions],
         "data_retries": len(retries),
     }
 
@@ -211,6 +249,49 @@ def summarize_run(run_dir: str) -> dict:
         "postmortems": postmortems,
     }
     return summary
+
+
+def render_recovery_lines(rec: dict) -> list[str]:
+    """Recovery-table lines — shared by the single-host report and the
+    multi-host aggregate so the two renderings cannot drift. Elastic
+    incidents (world resizes) annotate their incident line with the
+    old→new world size; eviction requests get their own lines."""
+    lines = [
+        f"recovery: {rec['restarts']} restart(s), "
+        f"{len(rec['quarantined'])} checkpoint(s) quarantined, "
+        f"{rec['data_retries']} data retr"
+        f"{'y' if rec['data_retries'] == 1 else 'ies'}"
+        + (f", {len(rec['elastic'])} elastic resize(s)"
+           if rec.get("elastic") else "")]
+    for i, inc in enumerate(rec["incidents"]):
+        ttr = inc.get("time_to_recover_s")
+        lost = inc.get("steps_lost")
+        line = (
+            f"  incident {i}: resumed at step "
+            f"{inc.get('resumed_at_step')}"
+            + (f" ({lost} step(s) lost)" if lost is not None else "")
+            + (f", recovered in {ttr:.1f}s" if ttr is not None
+               else ""))
+        if "new_world" in inc:
+            line += (f", world {inc.get('old_world')} -> "
+                     f"{inc['new_world']}")
+            if inc.get("evicted_hosts"):
+                line += (" (evicted host(s) "
+                         + ",".join(map(str, inc["evicted_hosts"]))
+                         + ")")
+        lines.append(line)
+    for ev in rec.get("eviction_requests", []):
+        lines.append(
+            f"  EVICTION REQUESTED: host {ev.get('host')} at step "
+            f"{ev.get('step')} ({ev.get('ratio')}x median on "
+            f"{ev.get('metric')})")
+    for q in rec["quarantined"]:
+        lines.append(f"  QUARANTINED step {q.get('step')}: "
+                     f"{q.get('path')}")
+    if rec["faults_injected"]:
+        lines.append("  faults injected: "
+                     + ", ".join(map(str, rec["faults_injected"])))
+    return lines
 
 
 def render(summary: dict) -> str:
@@ -278,27 +359,7 @@ def render(summary: dict) -> str:
                          f"{a['total_s']:9.3f}s  {a['max_s']:8.3f}s")
     rec = summary.get("recovery")
     if rec:
-        lines.append(
-            f"recovery: {rec['restarts']} restart(s), "
-            f"{len(rec['quarantined'])} checkpoint(s) quarantined, "
-            f"{rec['data_retries']} data retr"
-            f"{'y' if rec['data_retries'] == 1 else 'ies'}")
-        for i, inc in enumerate(rec["incidents"]):
-            ttr = inc.get("time_to_recover_s")
-            lost = inc.get("steps_lost")
-            lines.append(
-                f"  incident {i}: resumed at step "
-                f"{inc.get('resumed_at_step')}"
-                + (f" ({lost} step(s) lost)" if lost is not None
-                   else "")
-                + (f", recovered in {ttr:.1f}s" if ttr is not None
-                   else ""))
-        for q in rec["quarantined"]:
-            lines.append(f"  QUARANTINED step {q.get('step')}: "
-                         f"{q.get('path')}")
-        if rec["faults_injected"]:
-            lines.append("  faults injected: "
-                         + ", ".join(map(str, rec["faults_injected"])))
+        lines.extend(render_recovery_lines(rec))
     for w in summary.get("watchdog_firings", []):
         lines.append(f"WATCHDOG FIRED: {w.get('postmortem')}")
     for p in summary.get("postmortems", []):

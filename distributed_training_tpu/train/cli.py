@@ -51,6 +51,23 @@ def main(argv: list[str] | None = None) -> int:
     setup_logging(cfg.run.log_level,
                   os.path.join(run_dir, cfg.run.log_file),
                   rt.process_index)
+    from distributed_training_tpu.resilience import elastic
+    if cfg.train.global_batch_size:
+        # Elastic contract: the GLOBAL batch is world-size-invariant;
+        # the per-shard batch is derived from however many data shards
+        # this incarnation's mesh resolved to (a shrunken world gets a
+        # proportionally larger per-shard batch). Fails loudly on an
+        # uneven split — silently changing the effective batch would
+        # change the optimization trajectory.
+        cfg.train.batch_size = elastic.per_shard_batch(
+            cfg.train.global_batch_size, rt.data_shard_count)
+        logger.info("global batch %d over %d shard(s) -> per-shard "
+                    "batch %d", cfg.train.global_batch_size,
+                    rt.data_shard_count, cfg.train.batch_size)
+    # Topology this incarnation inherited from the elastic supervisor
+    # (empty outside --elastic runs); recorded in the resume event so
+    # postmortems can read the world-size history off the run stream.
+    evicted_hosts = elastic.evicted_from_env()
     if not cfg.train.metrics_jsonl:
         cfg.train.metrics_jsonl = os.path.join(run_dir, "metrics.jsonl")
     # Multi-host: every process records its OWN event stream under
@@ -58,9 +75,15 @@ def main(argv: list[str] | None = None) -> int:
     # the instrumentation path, and a dead coordinator would take all
     # evidence with it). The summarizer auto-detects the layout and
     # merges (telemetry/aggregate.py). Single-process runs keep the
-    # flat <run_dir>/events.jsonl.
-    host_dir = (run_dir if rt.process_count == 1 else
-                os.path.join(run_dir, f"host_{rt.process_index}"))
+    # flat <run_dir>/events.jsonl — EXCEPT under an elastic
+    # supervisor: a run shrunk all the way to world 1 must keep
+    # appending to host_0/events.jsonl, or the aggregate's recovery
+    # table (which reads the coordinator's per-host stream) silently
+    # loses the final incarnations of the topology history.
+    elastic_incarnation = os.environ.get(elastic.ENV_WORLD) is not None
+    host_dir = (run_dir
+                if rt.process_count == 1 and not elastic_incarnation
+                else os.path.join(run_dir, f"host_{rt.process_index}"))
     if not cfg.train.events_jsonl:
         cfg.train.events_jsonl = os.path.join(host_dir, "events.jsonl")
     logger.info("config loaded; %s", rt.describe())
@@ -84,7 +107,8 @@ def main(argv: list[str] | None = None) -> int:
         fault_injector = faults.FaultInjector(
             faults.parse_fault_plan(cfg.train.fault_plan),
             ledger_path=os.path.join(host_dir, "faults_fired.json"),
-            ckpt_dir=cfg.train.snapshot_path)
+            ckpt_dir=cfg.train.snapshot_path,
+            host=rt.process_index)
 
     dataset = build_dataset(
         cfg.train.dataset,
@@ -145,7 +169,8 @@ def main(argv: list[str] | None = None) -> int:
             enabled=True,
             fresh=not (resumed or restart_count > 0),
             start_step=checkpointer.latest_step() or 0,
-            host_id=(rt.process_index if rt.process_count > 1
+            host_id=(rt.process_index
+                     if rt.process_count > 1 or elastic_incarnation
                      else None)))
         # Clock-sync record: the runtime captured one barrier-anchored
         # timestamp per host at setup; emitting it into each stream is
@@ -175,7 +200,9 @@ def main(argv: list[str] | None = None) -> int:
             # recovery table must not undercount those.
             tel.event("resume", step=trainer.global_step,
                       epoch=trainer.epochs_run,
-                      restarts=restart_count)
+                      restarts=restart_count,
+                      world_size=rt.process_count,
+                      evicted_hosts=evicted_hosts)
         try:
             if cfg.train.profile_dir:
                 from distributed_training_tpu.utils import profiler
@@ -193,11 +220,20 @@ def main(argv: list[str] | None = None) -> int:
         logger.info("training done: %s", summary)
     # Exit-status sentinel for the restart supervisor: a preempted run
     # exits 0 after its final save just like a completed one — only
-    # this record tells the supervisor to relaunch vs. stand down.
+    # this record tells the supervisor to relaunch vs. stand down. A
+    # coordinated eviction also exits 0; its host_lost sentinel names
+    # the evictee so the elastic supervisor shrinks around it.
     # No-op when unsupervised (no DTT_EXIT_SENTINEL in env).
-    sup.write_exit_status(
-        sup.PREEMPTED if guard.should_stop else sup.COMPLETED,
-        step=trainer.global_step, epochs_run=trainer.epochs_run)
+    evict = trainer.straggler.evict_request
+    if evict is not None:
+        sup.write_exit_status(
+            sup.HOST_LOST, step=trainer.global_step,
+            epochs_run=trainer.epochs_run,
+            lost_host=evict["host"], reason=evict.get("reason"))
+    else:
+        sup.write_exit_status(
+            sup.PREEMPTED if guard.should_stop else sup.COMPLETED,
+            step=trainer.global_step, epochs_run=trainer.epochs_run)
     return 0
 
 

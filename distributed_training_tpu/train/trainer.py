@@ -12,6 +12,7 @@ strategy's sharding layout (no imperative collectives anywhere).
 from __future__ import annotations
 
 import logging
+import os
 import time
 from typing import Any, Iterable, Mapping
 
@@ -230,11 +231,14 @@ class Trainer:
         # context.
         from distributed_training_tpu.telemetry.straggler import (
             StragglerDetector)
+        from distributed_training_tpu.resilience import elastic
         self.straggler = StragglerDetector(
             runtime,
             every=cfg.train.straggler_every,
             threshold=cfg.train.straggler_threshold,
-            persist=cfg.train.straggler_persist)
+            persist=cfg.train.straggler_persist,
+            evict_after=cfg.train.straggler_evict_after,
+            elastic_dir=os.environ.get(elastic.ENV_ELASTIC_DIR))
         tcfg = cfg.train
         if (tcfg.grad_accum_steps > 1
                 and loader.batch_size % tcfg.grad_accum_steps):
@@ -419,6 +423,16 @@ class Trainer:
     # -- cooperative stop / health ----------------------------------------
 
     _stop_agreed: bool = False
+
+    @property
+    def _stopping_early(self) -> bool:
+        """Leaving the run before its epochs are done — preemption
+        (agreed across hosts) or a coordinated eviction stop. Both
+        must force a final save; the exit sentinel tells the
+        supervisor which it was (train/cli.py)."""
+        return self._stop_agreed or (
+            self.straggler is not None
+            and self.straggler.evict_request is not None)
 
     def _compute_bwd_specs(self) -> dict:
         """Per-leaf PARAM-layout shardings for the gather-for-compute
@@ -617,6 +631,14 @@ class Trainer:
                     self.watchdog.disarm()
                 break
             t_step0 = time.perf_counter()
+            if self.faults is not None:
+                # slow_host fault: the injected degradation must land
+                # INSIDE the measured step region so the straggler
+                # detector attributes it exactly like a real slow
+                # host. A pure host-local sleep — no collective.
+                delay_s = self.faults.step_delay(self.global_step + 1)
+                if delay_s:
+                    time.sleep(delay_s)
             metrics = self.train_step(batch)
             if self.straggler.enabled:
                 self.straggler.record_step(
@@ -628,6 +650,23 @@ class Trainer:
                         is not None and self.watchdog is not None):
                     self.watchdog.set_context(
                         self.straggler.watchdog_info())
+            if self.straggler.evict_request is not None:
+                # Coordinated eviction stop: the request derives from
+                # the all-gathered table at this exchange step, so
+                # EVERY host sees it here, at the same loop point —
+                # all break together, save, and exit cleanly; no host
+                # is left waiting in a collective during teardown.
+                if self.watchdog is not None:
+                    self.watchdog.disarm()
+                logger.warning(
+                    "stopping for elastic eviction of host %s "
+                    "(requested at step %s)",
+                    self.straggler.evict_request.get("host"),
+                    self.straggler.evict_request.get("step"))
+                self.metrics.record(self.global_step, metrics,
+                                    epoch=epoch)
+                losses.append(metrics["loss"])
+                break
             if div_every and self.global_step % div_every == 0:
                 # Compiled cross-replica drift check (SURVEY.md §5.2's
                 # "diff the rank logs", formalized).
@@ -687,14 +726,14 @@ class Trainer:
             eval_every = self.cfg.train.eval_every
             if (self.eval_loader is not None and eval_every
                     and (epoch + 1) % eval_every == 0
-                    and not self._stop_agreed):
+                    and not self._stopping_early):
                 val_loss = self.evaluate(self.eval_loader.epoch(epoch))
                 summary["val_loss"] = val_loss
                 # Unthrottled: epoch-end eval must never be dropped by
                 # the per-step log_every window.
                 self.metrics.record_scalar(self.global_step, "val_loss",
                                            val_loss, epoch=epoch)
-            preempted = self._stop_agreed
+            preempted = self._stopping_early
             save_every = self.cfg.train.save_every
             if self.checkpointer is not None and (
                     preempted or (save_every > 0
@@ -714,8 +753,10 @@ class Trainer:
                     # the portable artifact either.
                     self.export_consolidated(epoch=meta_epoch)
             if preempted:
-                logger.warning("stopping at epoch %d due to preemption",
-                               epoch)
+                logger.warning(
+                    "stopping at epoch %d due to %s", epoch,
+                    "preemption" if self._stop_agreed
+                    else "elastic eviction")
                 break
             self.epochs_run = epoch + 1
         if self.checkpointer is not None:

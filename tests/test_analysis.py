@@ -37,7 +37,7 @@ def _rules(tmp_path, src, name="x.py"):
 
 def test_registry_has_all_rules():
     assert {"DTT001", "DTT002", "DTT003", "DTT004", "DTT005",
-            "DTT006"} <= set(pitfalls.RULES)
+            "DTT006", "DTT007"} <= set(pitfalls.RULES)
 
 
 def test_tests_directory_is_exempt(tmp_path):
@@ -194,6 +194,63 @@ def test_dtt006_decorator_forms(tmp_path):
         "@jax.jit\n"
         "def render_frame(x):\n"
         "    return x\n"))
+
+
+# ---------------------------------------------------------------------------
+# DTT007 — hard-coded world size in elastic hot paths
+# ---------------------------------------------------------------------------
+
+
+def _rules_scoped(tmp_path, src, rel="distributed_training_tpu/train"):
+    d = tmp_path / rel
+    d.mkdir(parents=True, exist_ok=True)
+    p = d / "x.py"
+    p.write_text(src)
+    return pitfalls.check_file_rules(str(p), repo=str(tmp_path))
+
+
+def test_dtt007_flags_world_size_literals(tmp_path):
+    problems = _rules_scoped(tmp_path, (
+        "def f(rt, host_dirs):\n"
+        "    if rt.process_count == 2:\n"
+        "        pass\n"
+        "    if jax.process_count() >= 4:\n"
+        "        pass\n"
+        "    for h in range(4):\n"
+        "        print(host_dirs[h])\n"))
+    assert len([p for p in problems if "DTT007" in p]) == 3, problems
+
+
+def test_dtt007_world_agnostic_forms_pass(tmp_path):
+    """0/1 comparisons (single-process check, coordinator gating),
+    runtime-derived counts, host-free range loops, noqa, and files
+    outside the elastic hot paths are all legal."""
+    assert not _rules_scoped(tmp_path, (
+        "def f(rt, host_dirs):\n"
+        "    single = rt.process_count == 1\n"
+        "    coord = rt.process_index == 0\n"
+        "    for h in range(rt.process_count):\n"
+        "        print(host_dirs[h])\n"
+        "    for i in range(4):\n"
+        "        print(i)\n"))
+    assert not _rules_scoped(tmp_path, (
+        "def f(rt):\n"
+        "    return rt.process_count == 2  # noqa: DTT007 — fixture\n"))
+    # A literal-bounded RETRY loop is not a world-size pin: substring
+    # hits like subprocess/multiprocessing/hostname must not trip the
+    # host/shard-indexed-state heuristic.
+    assert not _rules_scoped(tmp_path, (
+        "def f(cmd):\n"
+        "    for attempt in range(3):\n"
+        "        subprocess.run(cmd)\n"
+        "    for i in range(2):\n"
+        "        multiprocessing.get_context()\n"
+        "        socket.gethostname()\n"))
+    # benchmarks/ may pin a world deliberately: out of scope.
+    assert not _rules_scoped(tmp_path, (
+        "def f(rt, host_dirs):\n"
+        "    if rt.process_count == 2:\n"
+        "        pass\n"), rel="benchmarks")
 
 
 # ---------------------------------------------------------------------------
