@@ -4,9 +4,11 @@
 Emits one JSON line per plan: the 1B single-chip measurement config
 (what bench_1b_single_chip.py runs when a healthy chip window opens)
 and the 1B/7B production layouts on the BASELINE target hardware
-(v4-32: 32 GiB HBM/chip). Planning numbers from
-utils/memory.estimate_transformer_memory — the same calibrated model
-the auto-batch bench resolver uses — not allocator ground truth.
+(v4-32: 32 GiB HBM/chip). Thin wrapper over the auto-parallelism
+planner's HBM scoring (``parallel/planner.py::hbm_plan_record`` —
+itself utils/memory.estimate_transformer_memory, the one calibrated
+memory model; PR 6's audit_collectives precedent): this script keeps
+its CLI/UX, the cost model lives in exactly one place.
 
     python benchmarks/plan_memory.py            # all plans, one JSON/line
 """
@@ -52,28 +54,9 @@ PLANS = [
 
 def plan(name: str, preset: str, chip: str, overrides: dict,
          layout: dict) -> dict:
-    from distributed_training_tpu.models.transformer import (
-        PRESETS, TransformerConfig)
-    from distributed_training_tpu.utils.memory import (
-        HBM_GIB, estimate_transformer_memory)
-
-    cfg = TransformerConfig(dtype="bfloat16",
-                            **{**PRESETS[preset], **overrides})
-    est = estimate_transformer_memory(cfg, **layout)
-    return {
-        "plan": name,
-        "preset": preset,
-        "chip": chip,
-        "hbm_gib": HBM_GIB[chip],
-        "overrides": overrides,
-        "layout": layout,
-        "params_gib": round(est.params_gib, 2),
-        "grads_gib": round(est.grads_gib, 2),
-        "opt_gib": round(est.opt_gib, 2),
-        "activations_gib": round(est.activations_gib, 2),
-        "total_gib": round(est.total_gib, 2),
-        "fits": est.fits(chip),
-    }
+    from distributed_training_tpu.parallel.planner import (
+        hbm_plan_record)
+    return hbm_plan_record(name, preset, chip, overrides, layout)
 
 
 def main() -> int:

@@ -119,6 +119,14 @@ def main(argv=None) -> int:
             print(f"[analysis] {len(cmp['new'])} NEW audit "
                   "finding(s) not in baseline")
             rc = 1
+        pins = audit.pinned_violations(doc)
+        for p in pins:
+            print(f"[analysis] PIN violation: {p}")
+        if pins:
+            # Pins outrank the baseline: a fixed-and-pinned finding
+            # class returning is a regression even when --write-
+            # baseline would happily freeze it.
+            rc = 1
 
     if not args.check:
         return 0

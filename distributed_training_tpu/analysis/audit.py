@@ -213,6 +213,26 @@ def all_findings(audit_doc: dict) -> list[dict]:
     return [f for r in audit_doc["targets"] for f in r["findings"]]
 
 
+def pinned_violations(audit_doc: dict) -> list[str]:
+    """Violations of per-target ``pin_zero`` pins: a finding whose
+    code the target pins to zero fails the gate EVEN IF its
+    fingerprint is baselined — the ratchet lets known debt ride, the
+    pin keeps a fixed cliff fixed (both r05 and the headline target
+    pin SPMD001 after the embedding-gather fix)."""
+    out: list[str] = []
+    for r in audit_doc["targets"]:
+        t = targets_lib.TARGETS.get(r["target"])
+        pins = tuple(getattr(t, "pin_zero", ()) or ()) if t else ()
+        for code in pins:
+            n = r["findings_by_code"].get(code, 0)
+            if n:
+                out.append(
+                    f"{r['target']}: {n} {code} finding(s), but this "
+                    f"target pins {code} to ZERO "
+                    f"({CODES.get(code, '?')})")
+    return out
+
+
 def render_report(audit_doc: dict, cmp: dict | None = None
                   ) -> list[str]:
     """Human report lines. With ``cmp`` (``baseline.compare`` output)

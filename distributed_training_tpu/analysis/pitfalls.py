@@ -39,6 +39,12 @@ Rule catalog (details in docs/static-analysis.md):
   over hosts/shards, in trainer/data/telemetry hot paths — elastic
   runs (resilience/elastic.py) resize the world mid-run, and these
   literals break silently at any other size.
+- DTT008 raw PartitionSpec literal: a ``P("fsdp", ...)``-style
+  axis-name literal in models/ or train/ bypasses the named sharding
+  map (parallel/strategy.py producers, parallel/planner.py resolved
+  plans) — the single-spec-source discipline the auto-parallelism
+  planner enforces. Specs DERIVED from runtime/strategy objects
+  (``P(b_axes, None)``, ``P(*sh.spec[1:])``, ``P()``) stay legal.
 """
 
 from __future__ import annotations
@@ -571,6 +577,69 @@ def _check_world_size_literal(ctx: FileContext):
                        "over host/shard-indexed state "
                        f"({sorted(hostish)[0]}) — a fixed world size; "
                        "derive the count from the runtime")
+
+
+# ---------------------------------------------------------------------------
+# DTT008 — raw PartitionSpec axis literals outside the sharding map
+# ---------------------------------------------------------------------------
+
+# Paths where hard-coded mesh-axis names in PartitionSpec calls are
+# banned: model and trainer hot paths. The legitimate homes of axis
+# literals — parallel/strategy.py (spec producers), parallel/
+# planner.py (resolved plans), runtime.py (axis constants) — are
+# outside this scope by construction.
+DTT008_SCOPED = (
+    os.path.join("distributed_training_tpu", "models"),
+    os.path.join("distributed_training_tpu", "train"),
+)
+_PSPEC_NAMES = {"PartitionSpec", "P"}
+
+
+@_rule("DTT008", "raw-partition-spec-literal",
+       "PartitionSpec axis-name literal outside the sharding map")
+def _check_raw_pspec(ctx: FileContext):
+    """``P("fsdp")`` / ``PartitionSpec(("dp", "fsdp"), None)`` in
+    models/ or train/ hard-codes a layout decision the planner's
+    sharding-map-by-name (and the strategy producers behind it) is
+    supposed to own — exactly the per-strategy spec scattering
+    veScale warns about and PR 8 removed. Only STRING literals in
+    the call's arguments flag: ``P()``, ``P(None, ...)``, and specs
+    built from runtime-derived variables (``P(b_axes or None,
+    head_ax, None)``) are how models legitimately constrain
+    activations without naming axes."""
+    if not any(ctx.rel.startswith(p + os.sep) or ctx.rel == p
+               for p in DTT008_SCOPED):
+        return
+    def _axis_literals(arg):
+        """String constants in the AXIS positions only: the argument
+        itself, or direct elements of a tuple/list argument. Strings
+        nested deeper (inside comparisons, calls, subscripts —
+        ``P(None if kind == "bias" else head_ax)``) are data of a
+        DERIVED spec, not axis names, and must not flag."""
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            return [arg.value]
+        if isinstance(arg, (ast.Tuple, ast.List)):
+            return [e.value for e in arg.elts
+                    if isinstance(e, ast.Constant)
+                    and isinstance(e.value, str)]
+        return []
+
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Call)
+                and _terminal_name(node.func) in _PSPEC_NAMES):
+            continue
+        literals = [
+            lit
+            for arg in list(node.args) + [kw.value
+                                          for kw in node.keywords]
+            for lit in _axis_literals(arg)]
+        if literals:
+            yield (node.lineno,
+                   f"PartitionSpec with axis-name literal(s) "
+                   f"{sorted(set(literals))} outside the named "
+                   "sharding map — route the layout through "
+                   "parallel/strategy.py rules or a resolved plan "
+                   "(parallel/planner.py)")
 
 
 @_rule("DTT006", "undonated-train-step",

@@ -7,22 +7,35 @@ is reproducible run-over-run and the committed baseline
 config/strategy combination becomes a supported path; the ratchet
 then freezes its current findings and fails CI on any new one.
 
-The two seed targets mirror the repo's live evidence:
+The three targets mirror the repo's live evidence:
 
 - ``multichip_r05_tp_sp_fsdp``: the exact dryrun pass-1 configuration
-  from ``__graft_entry__.py`` (the one ``MULTICHIP_r05.json`` records
-  with two "Involuntary full rematerialization" warnings on the
-  gather/all-gather path) — the repro ROADMAP item 1's auto-planner
-  must drive to zero.
+  from ``__graft_entry__.py`` — the one whose ``MULTICHIP_r05.json``
+  log recorded two "Involuntary full rematerialization" warnings on
+  the gather/all-gather path. The embedding-table gather-for-compute
+  constraint (models/transformer.py ``_gathered_table``) fixed the
+  cliff; the target is now PINNED to zero SPMD001 findings
+  (``pin_zero``) so the fix can never silently regress, baselined or
+  not.
 - ``single_chip_headline``: the 0.4392-MFU gpt2_125m single-chip
   headline configuration (bench.py HEADLINE_MODEL_KWARGS + the gpt2
   train defaults). Audit-sized batch — findings are sharding
   properties of the compiled program, not batch-magnitude properties
   — and it must stay at ZERO findings.
+- ``multichip_r06_planned``: the committed auto-parallelism plan
+  (``conf/plans/multichip_8dev.json`` — parallel/planner.py) compiled
+  through the SAME trainer path ``benchmarks/bench_multichip.py``
+  measures, with the plan pinned via ``train.sharding_plan``. This is
+  the "zero involuntary-reshard warnings on the chosen plan" gate:
+  the planner's own ``--check`` verifies the plan is still the
+  search's winner; THIS target re-proves it compiles clean on the
+  current XLA.
 """
 
 from __future__ import annotations
 
+import json
+import os
 from dataclasses import dataclass, field
 
 
@@ -38,6 +51,11 @@ class AuditTarget:
     seq_len: int = 32
     mesh_axes: dict = field(default_factory=dict)
     train_overrides: dict = field(default_factory=dict)
+    # Finding codes this target pins to ZERO: unlike the baseline
+    # ratchet (which lets KNOWN findings ride), a pinned code fails
+    # --check even if its fingerprints are baselined — the mechanism
+    # that keeps a FIXED cliff fixed.
+    pin_zero: tuple = ()
     note: str = ""
 
 
@@ -64,11 +82,15 @@ _register(AuditTarget(
     mesh_axes=dict(fsdp=2, sp=2, tp=2),
     train_overrides=dict(min_shard_elems=1, dtype="float32",
                          optimizer="adamw"),
+    pin_zero=("SPMD001",),
     note="__graft_entry__.py dryrun pass 1 — the MULTICHIP_r05.json "
-         "configuration whose SPMD log shows involuntary full "
-         "rematerialization on the gather/all-gather path. Known "
-         "findings are baselined; ROADMAP item 1's planner drives "
-         "them to zero.",
+         "configuration whose SPMD log used to show involuntary full "
+         "rematerialization on the gather/all-gather path (the token-"
+         "embedding lookup). Fixed by the embedding-table gather-for-"
+         "compute constraint; SPMD001 is pinned to zero so the cliff "
+         "cannot return. The ring's collective-permutes stay "
+         "baselined as SPMD002 (src->tgt pairs match no axis "
+         "grouping by construction).",
 ))
 
 _register(AuditTarget(
@@ -83,10 +105,65 @@ _register(AuditTarget(
     seq_len=1024,
     mesh_axes={},
     train_overrides=dict(dtype="bfloat16", optimizer="adamw"),
+    pin_zero=("SPMD001",),
     note="bench.py headline configuration (HEADLINE_MODEL_KWARGS, "
          "seq 1024, adamw bf16). Single chip: zero collectives, zero "
          "reshard warnings — any finding here is a regression.",
 ))
+
+
+def _register_planned_target() -> None:
+    """The committed plan as an audit target: read the raw plan JSON
+    (stdlib only — no planner/jax import at module import time) and
+    pin its exact configuration. Skipped silently if the plan file is
+    absent (a fresh checkout mid-replan); the planner --check gate
+    fails loudly in that case."""
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))), "conf", "plans",
+        "multichip_8dev.json")
+    if not os.path.exists(path):
+        return
+    try:
+        with open(path, encoding="utf-8") as f:
+            plan = json.load(f)
+    except (OSError, ValueError):
+        # A corrupt/unreadable committed plan must not kill every
+        # analysis import — the planner --check gate names the
+        # problem loudly; this registration just goes without its
+        # target until the plan is regenerated.
+        return
+    mk = dict(plan["inputs"]["model_kwargs"])
+    if plan["remat"] == "none":
+        mk["remat"] = False
+    else:
+        mk.update(remat=True, remat_policy=plan["remat"])
+    _register(AuditTarget(
+        name="multichip_r06_planned",
+        title=f"8-device auto-planned config "
+              f"(plan {plan['name']}@{plan['fingerprint']})",
+        devices=plan["devices"],
+        strategy=plan["base_strategy"],
+        model="transformer",
+        model_kwargs=mk,
+        batch_size=plan["batch_per_shard"],
+        seq_len=plan["seq_len"],
+        mesh_axes={a: s for a, s in plan["mesh"].items() if s > 1},
+        train_overrides=dict(
+            sharding_plan=plan["name"],
+            min_shard_elems=plan["inputs"]["min_shard_elems"],
+            dtype=mk.get("dtype", "float32"),
+            optimizer=plan["inputs"]["optimizer"]),
+        pin_zero=("SPMD001",),
+        note="The committed auto-parallelism plan (conf/plans/) "
+             "compiled through the trainer's PlannedStrategy path — "
+             "the configuration benchmarks/bench_multichip.py "
+             "measures for MULTICHIP_r06.json. Zero SPMD001 pinned: "
+             "the planner must never ship a resharding layout.",
+    ))
+
+
+_register_planned_target()
 
 
 def resolve(names=None) -> list[AuditTarget]:

@@ -125,6 +125,13 @@ class TrainConfig:
     # "zero1" (DDP compute, moments sharded over data axes),
     # "hybrid" (FSDP in-slice, replicate across dp), "tp".
     parallel_strategy: str = "ddp"
+    # Resolved auto-parallelism plan (parallel/planner.py): a
+    # committed plan name (conf/plans/<name>.json) or a path. When
+    # set, the trainer compiles against the plan's sharding-map-by-
+    # name (PlannedStrategy) instead of parallel_strategy's ad-hoc
+    # specs, and the CLI derives cfg.mesh from the plan (dp as the
+    # elastic wildcard). Empty → legacy per-strategy specs.
+    sharding_plan: str = ""
     seed: int = 42
     optimizer: str = "sgd"        # "sgd" | "adamw" | "adafactor"
     weight_decay: float = 0.0

@@ -461,6 +461,32 @@ class Transformer:
             x, NamedSharding(self.mesh,
                              P(b_axes, *([U] * (x.ndim - 1)))))
 
+    def _gathered_table(self, tbl: jax.Array) -> jax.Array:
+        """Constrain an embedding TABLE replicated at its lookup site.
+
+        The token-embedding gather is the tp+sp+fsdp reshard cliff
+        MULTICHIP_r05.json recorded: with the table model-sharded
+        (vocab over tp, embed over fsdp) and the lookup's consumers
+        demanding batch/seq-sharded activations, GSPMD cannot bridge
+        the two shardings and falls back to "Involuntary full
+        rematerialization" — replicating the ACTIVATION-scale gather
+        result on every device (the SPMD001 finding analysis/ gates
+        on; pinning the OUTPUT sharding does not help, the partitioner
+        still computes the gather in the table's layout first).
+        Replicating the TABLE instead makes the gather shard-local
+        over batch/seq: one param-scale all-gather in compute dtype —
+        the same gather-for-compute discipline the FSDP binding
+        applies through ``_w`` (which already covers this table when
+        bound, hence the ``_compute_replicate`` guard). Inside the
+        pipeline's shard_map every axis is manual and stage params
+        arrive gathered, so the constraint is skipped there."""
+        if (self.mesh is None or self._inside_pp
+                or self._compute_replicate is not None):
+            return tbl
+        from jax.sharding import NamedSharding, PartitionSpec
+        return jax.lax.with_sharding_constraint(
+            tbl, NamedSharding(self.mesh, PartitionSpec()))
+
     def _attention(self, q, k, v, layout: str = "bshd"):
         c = self.cfg
         # A window covering the whole (or more of the) sequence is
@@ -803,7 +829,11 @@ class Transformer:
         # indexing, so a vocab-sharded embedding is all-gathered once
         # (param-scale, bf16) instead of the lookup emitting an
         # activation-scale (B, S, D) all-reduce of one-hot partials.
-        x = self._w(params["tok_embed"], dt, "tok_embed")[tokens]
+        # _gathered_table extends the same discipline to EVERY sharded
+        # strategy: this lookup is the MULTICHIP_r05 reshard cliff
+        # (SPMD001), fixed by constraining the table, not the output.
+        x = self._gathered_table(
+            self._w(params["tok_embed"], dt, "tok_embed"))[tokens]
         positions = jnp.arange(S)
         if c.pos_encoding == "learned":
             x = x + self._w(params["pos_embed"], dt,

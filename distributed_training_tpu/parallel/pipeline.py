@@ -329,13 +329,19 @@ def pipeline_apply(body_fn: Callable, stacked_params, x: jax.Array,
     fn = shard_map(
         inner,
         mesh=mesh,
-        in_specs=(param_specs, P(AXIS_PP), xspec, P()),
-        out_specs=(xspec, P()),
+        in_specs=(param_specs, P(AXIS_PP), xspec, P(None)),
+        out_specs=(xspec, P(None)),
         check_rep=False,
     )
+    # The aux accumulator crosses the shard_map boundary as shape (1,)
+    # rather than a scalar: when the aux actually carries gradient
+    # (MoE load-balancing loss), shard_map's partial-eval stages a
+    # scalar residual whose out-names check fails (_SpecError) on this
+    # jax — a rank-1 carry sidesteps it, and the squeeze below keeps
+    # the external contract (scalar aux) unchanged.
     out_mb, aux = fn(stacked_params, layer_ids, x_mb,
-                     jnp.zeros((), jnp.float32))
+                     jnp.zeros((1,), jnp.float32))
     out_mb = jax.lax.with_sharding_constraint(
         out_mb, NamedSharding(mesh, xspec))
     out = jnp.swapaxes(out_mb, 0, 1).reshape(B, *x.shape[1:])
-    return out, aux
+    return out, aux[0]

@@ -152,6 +152,15 @@ class ShardingStrategy(ABC):
     # path stays primary (the gather is O(model) HBM + host RAM).
     gather_on_save: bool = False
 
+    @property
+    def wants_gather_for_compute(self) -> bool:
+        """Whether the trainer should bind the model's gather-for-
+        compute constraint (weights all-gather per layer, activations
+        never pay collective traffic) for this layout. True for the
+        FSDP family; ``PlannedStrategy`` delegates to its plan's base
+        strategy."""
+        return self.name == "fsdp"
+
     @abstractmethod
     def param_spec(self, shape: tuple[int, ...],
                    logical: tuple[str | None, ...] | None) -> P:

@@ -44,8 +44,12 @@ SCHEMA = 1
 # event (everything except the per-row detail). Single-host and
 # multi-host summaries both filter through this, so a SCHEMA bump
 # cannot leave the two reports disagreeing about which keys exist.
+# ``sharding_plan`` (additive, absent on unplanned runs) is the
+# resolved auto-parallelism plan's provenance — name/fingerprint/
+# remat/base_strategy from parallel/planner.py.
 SUMMARY_KEYS = ("schema", "total_collectives", "bytes_per_step",
-                "by_kind", "by_axis", "mesh", "spmd_reshard_warnings")
+                "by_kind", "by_axis", "mesh", "spmd_reshard_warnings",
+                "sharding_plan")
 
 
 def summary_of_event(rec: dict) -> dict:
@@ -75,6 +79,12 @@ def render_lines(coll: dict) -> list[str]:
             f"  SPMD reshard warnings: {coll['spmd_reshard_warnings']} "
             "(involuntary full rematerialization — see "
             "docs/static-analysis.md)")
+    sp = coll.get("sharding_plan")
+    if sp:
+        lines.append(
+            f"  sharding plan: {sp.get('name')}@"
+            f"{sp.get('fingerprint')} ({sp.get('base_strategy')}, "
+            f"remat={sp.get('remat')})")
     return lines
 
 COLLECTIVE_KINDS = ("all-reduce", "all-gather", "reduce-scatter",

@@ -47,10 +47,25 @@ def main(argv: list[str] | None = None) -> int:
     run_dir = os.path.join(cfg.run.output_dir, cfg.run.experiment_name)
     os.makedirs(run_dir, exist_ok=True)
 
+    plan = None
+    if cfg.train.sharding_plan:
+        # Pinned auto-parallelism plan (parallel/planner.py): the mesh
+        # is DERIVED from it — model-sharding axes pinned to the
+        # plan's extents, dp as the -1 wildcard so elastic
+        # incarnations (PR 7 shrink/grow) re-form around the same
+        # planned layout at a different data-parallel width. The
+        # Trainer re-validates the resolved mesh against the plan.
+        from distributed_training_tpu.parallel import planner
+        plan = planner.apply_plan_to_config(cfg)
+
     rt = initialize_runtime(cfg)
     setup_logging(cfg.run.log_level,
                   os.path.join(run_dir, cfg.run.log_file),
                   rt.process_index)
+    if plan is not None:
+        # After setup_logging, or the line never reaches the run log.
+        logger.info("sharding plan %s@%s: mesh derived %s",
+                    plan.name, plan.fingerprint(), plan.mesh)
     from distributed_training_tpu.resilience import elastic
     if cfg.train.global_batch_size:
         # Elastic contract: the GLOBAL batch is world-size-invariant;

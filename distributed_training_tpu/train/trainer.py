@@ -250,11 +250,29 @@ class Trainer:
                 f"grad_accum_steps={tcfg.grad_accum_steps} must divide "
                 f"the per-shard batch_size={loader.batch_size}")
 
-        from distributed_training_tpu.parallel import get_strategy
-        self.strategy: ShardingStrategy = get_strategy(
-            tcfg.parallel_strategy, runtime.spec,
-            min_shard_elems=tcfg.min_shard_elems,
-            gather_on_save=tcfg.gather_on_save)
+        # Sharding source: a resolved plan (parallel/planner.py) when
+        # one is pinned — the planner's sharding-map-by-name is then
+        # the single spec source the step compiles against — else the
+        # legacy per-strategy producers. The plan's mesh must be the
+        # runtime's mesh (dp may flex under an elastic incarnation,
+        # PR 7's wildcard contract); a mismatch is a config error that
+        # must fail here, not compile into a silently different
+        # layout.
+        self.plan = None
+        if tcfg.sharding_plan:
+            from distributed_training_tpu.parallel import planner
+            self.plan = planner.load_plan(tcfg.sharding_plan)
+            planner.check_plan_runtime(self.plan, runtime.spec)
+            self.strategy: ShardingStrategy = planner.PlannedStrategy(
+                plan=self.plan,
+                min_shard_elems=tcfg.min_shard_elems,
+                gather_on_save=tcfg.gather_on_save)
+        else:
+            from distributed_training_tpu.parallel import get_strategy
+            self.strategy = get_strategy(
+                tcfg.parallel_strategy, runtime.spec,
+                min_shard_elems=tcfg.min_shard_elems,
+                gather_on_save=tcfg.gather_on_save)
         if hasattr(model, "bind_mesh"):
             model.bind_mesh(runtime.mesh)
         total_steps = tcfg.total_steps or (
@@ -292,7 +310,7 @@ class Trainer:
                                             self.strategy.batch_spec())
 
         if (tcfg.fsdp_gather_for_compute
-                and self.strategy.name == "fsdp"
+                and self.strategy.wants_gather_for_compute
                 and hasattr(model, "bind_gather_for_compute")):
             # See TrainConfig.fsdp_gather_for_compute: weights gather
             # for compute; activations never pay collective traffic.
@@ -575,6 +593,16 @@ class Trainer:
                        if s > 1}
         rep["spmd_reshard_warnings"] = len(
             collectives.parse_reshard_warnings(cap.text))
+        if self.plan is not None:
+            # Plan provenance travels with the comms ledger: a
+            # MULTICHIP-style entry can then say WHICH resolved plan
+            # produced the traffic it records.
+            rep["sharding_plan"] = {
+                "name": self.plan.name,
+                "fingerprint": self.plan.fingerprint(),
+                "remat": self.plan.remat,
+                "base_strategy": self.plan.base_strategy,
+            }
         return rep
 
     def _maybe_emit_collectives(self, batch) -> None:

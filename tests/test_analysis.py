@@ -37,7 +37,7 @@ def _rules(tmp_path, src, name="x.py"):
 
 def test_registry_has_all_rules():
     assert {"DTT001", "DTT002", "DTT003", "DTT004", "DTT005",
-            "DTT006", "DTT007"} <= set(pitfalls.RULES)
+            "DTT006", "DTT007", "DTT008"} <= set(pitfalls.RULES)
 
 
 def test_tests_directory_is_exempt(tmp_path):
@@ -254,6 +254,51 @@ def test_dtt007_world_agnostic_forms_pass(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# DTT008 — raw PartitionSpec literals outside the sharding map
+# ---------------------------------------------------------------------------
+
+
+def test_dtt008_flags_axis_literals_in_scope(tmp_path):
+    problems = _rules_scoped(tmp_path, (
+        "from jax.sharding import PartitionSpec as P\n"
+        "a = P('fsdp')\n"
+        "b = PartitionSpec(('dp', 'fsdp'), None)\n"
+        "c = P(None, 'tp')\n"), rel="distributed_training_tpu/models")
+    assert len([p for p in problems if "DTT008" in p]) == 3, problems
+
+
+def test_dtt008_derived_specs_and_scope_pass(tmp_path):
+    # Derived/empty specs in scope are the legitimate model idiom —
+    # including strings nested in DERIVED expressions (comparison
+    # operands, call args), which are data, not axis names.
+    assert not [p for p in _rules_scoped(tmp_path, (
+        "from jax.sharding import PartitionSpec as P\n"
+        "a = P()\n"
+        "b = P(None, None)\n"
+        "c = P(b_axes or None, head_ax, None, None)\n"
+        "d = P(*sh.spec[1:])\n"
+        "e = P(None if kind == 'bias' else head_ax)\n"
+        "f = P(sh.axis_for('embed'))\n"),
+        rel="distributed_training_tpu/models") if "DTT008" in p]
+    # ...but a literal inside a TUPLE argument is an axis name.
+    assert [p for p in _rules_scoped(tmp_path, (
+        "from jax.sharding import PartitionSpec as P\n"
+        "a = P(('dp', 'fsdp'))\n"),
+        rel="distributed_training_tpu/models") if "DTT008" in p]
+    # Axis literals OUTSIDE models/train (the spec-producer homes)
+    # are exactly where they belong.
+    assert not [p for p in _rules_scoped(tmp_path, (
+        "from jax.sharding import PartitionSpec as P\n"
+        "a = P('fsdp', 'tp')\n"),
+        rel="distributed_training_tpu/parallel") if "DTT008" in p]
+    # noqa escape hatch.
+    assert not [p for p in _rules_scoped(tmp_path, (
+        "from jax.sharding import PartitionSpec as P\n"
+        "a = P('fsdp')  # noqa: DTT008 — deliberate pin\n"),
+        rel="distributed_training_tpu/train") if "DTT008" in p]
+
+
+# ---------------------------------------------------------------------------
 # Ratchet (baseline.py)
 # ---------------------------------------------------------------------------
 
@@ -366,24 +411,44 @@ def headline_report():
         targets.TARGETS["single_chip_headline"])
 
 
-def test_auditor_reproduces_multichip_r05_resharding(
-        tp_sp_fsdp_report):
-    """The gather-resharding repro from MULTICHIP_r05.json, now a
-    machine-checked finding instead of a log-tail grep: same ops
-    (%gather + %all-gather), same tensor f32[4,32,32], same
-    sharding transition."""
+def test_auditor_multichip_r05_resharding_fixed(tp_sp_fsdp_report):
+    """The MULTICHIP_r05 involuntary-remat repro (the token-embedding
+    gather under tp+sp+fsdp, recorded as two %gather/%all-gather
+    warnings on f32[4,32,32]) is FIXED by the embedding-table
+    gather-for-compute constraint: the same compile now reports zero
+    reshard warnings and zero SPMD001 findings — and the target pins
+    SPMD001 so the cliff cannot silently return (even baselined).
+    The ring's collective-permutes remain, as baselined SPMD002."""
     r = tp_sp_fsdp_report
-    assert r["spmd_reshard_warnings"] >= 2
-    spmd001 = [f for f in r["findings"] if f["code"] == "SPMD001"]
-    ops = {f["detail"]["op"] for f in spmd001}
-    assert {"gather", "all-gather"} <= ops
-    for f in spmd001:
-        assert f["detail"]["shape"] == "4,32,32"
-        assert "devices=[1,1,2,4]" in f["detail"]["from_sharding"]
-        assert "devices=[2,2,1,2]" in f["detail"]["to_sharding"]
+    assert r["spmd_reshard_warnings"] == 0
+    assert not [f for f in r["findings"] if f["code"] == "SPMD001"]
+    assert [f for f in r["findings"] if f["code"] == "SPMD002"]
     # The collectives event carries the count mechanically.
     assert r["collectives"]["spmd_reshard_warnings"] == \
         r["spmd_reshard_warnings"]
+    assert targets.TARGETS["multichip_r05_tp_sp_fsdp"].pin_zero == \
+        ("SPMD001",)
+
+
+def test_pinned_codes_fail_even_when_baselined():
+    """pin_zero outranks the ratchet: a baselined SPMD001 on a
+    pinned target still fails. Synthetic records — no compile."""
+    rec = {
+        "target": "multichip_r05_tp_sp_fsdp",
+        "title": "t", "devices": 8, "strategy": "tp", "mesh": {},
+        "spmd_reshard_warnings": 1,
+        "findings": [_f("SPMD001:multichip_r05_tp_sp_fsdp:x")],
+        "findings_by_code": {"SPMD001": 1},
+        "collectives": {},
+    }
+    doc = audit.assemble_doc([rec])
+    (violation,) = audit.pinned_violations(doc)
+    assert "SPMD001" in violation and "ZERO" in violation
+    # A non-pinned code rides the ratchet as before.
+    rec2 = dict(rec, findings=[_f("SPMD002:multichip_r05_tp_sp_fsdp:y")],
+                findings_by_code={"SPMD002": 1},
+                spmd_reshard_warnings=0)
+    assert audit.pinned_violations(audit.assemble_doc([rec2])) == []
 
 
 def test_auditor_headline_config_is_clean(headline_report):
@@ -426,11 +491,14 @@ def test_audit_targets_document_shape(tp_sp_fsdp_report):
     assert rec["target"] == "multichip_r05_tp_sp_fsdp"
     assert rec["mesh"] == {"fsdp": 2, "sp": 2, "tp": 2}
     assert doc["totals"]["findings"] == len(rec["findings"])
-    assert doc["totals"]["by_code"].get("SPMD001", 0) >= 2
+    # SPMD001 fixed (and pinned); the ring's permutes remain known.
+    assert doc["totals"]["by_code"].get("SPMD001", 0) == 0
+    assert doc["totals"]["by_code"].get("SPMD002", 0) >= 1
     # Render must tag known findings against the committed baseline.
-    cmp = baseline.compare(audit.all_findings(doc), baseline.load())
+    cmp = baseline.compare(audit.all_findings(doc), baseline.load(),
+                           targets=[rec["target"]])
     lines = "\n".join(audit.render_report(doc, cmp))
-    assert "[known]" in lines and "SPMD001" in lines
+    assert "[known]" in lines and "SPMD002" in lines
 
 
 # ---------------------------------------------------------------------------

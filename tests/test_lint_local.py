@@ -32,13 +32,27 @@ def test_repo_passes_local_lint_subset():
 
 def test_repo_passes_static_analysis_check():
     """The full gate: DTT rules clean AND the SPMD audit reproduces
-    only baselined findings (ratchet). Any new involuntary-reshard
-    warning, unattributed collective, or replicated large param on a
-    named target makes this red — the log-tail grep over
-    MULTICHIP_*.json is no longer the evidence."""
+    only baselined findings (ratchet), with per-target pin_zero pins
+    honored — the planned target (multichip_r06_planned) compiling
+    with ANY involuntary-reshard warning makes this red, which is the
+    'zero reshards on the chosen plan' acceptance gate."""
     out = subprocess.run(
         [sys.executable, "-m", "distributed_training_tpu.analysis",
          "--check", "--json", "-"],
+        capture_output=True, text=True, timeout=600, cwd=REPO)
+    assert out.returncode == 0, out.stdout + "\n" + out.stderr
+
+
+def test_repo_passes_planner_check():
+    """The planner gate: every committed plan in conf/plans/ is still
+    the deterministic search's winner (ranking, winner identity,
+    sharding-map fingerprint) and carries clean compile evidence.
+    The recompile that re-proves reshard-cleanliness on this XLA is
+    owned by the analysis gate above (multichip_r06_planned target),
+    so this stays cheap."""
+    out = subprocess.run(
+        [sys.executable, "-m",
+         "distributed_training_tpu.parallel.planner", "--check"],
         capture_output=True, text=True, timeout=600, cwd=REPO)
     assert out.returncode == 0, out.stdout + "\n" + out.stderr
 
@@ -52,7 +66,8 @@ def test_lint_and_analysis_share_one_rule_table():
     finally:
         sys.path.pop(0)
     assert {"DTT001", "DTT002", "DTT003", "DTT004", "DTT005",
-            "DTT006"} <= set(lint_local.pitfalls.RULES)
+            "DTT006", "DTT007", "DTT008"} <= set(
+        lint_local.pitfalls.RULES)
 
 
 def test_lint_local_catches_violations(tmp_path):

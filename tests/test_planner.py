@@ -1,0 +1,442 @@
+"""The auto-parallelism planner (parallel/planner.py).
+
+Four layers:
+- enumeration/scoring: pure arithmetic, no compiles;
+- plan artifact: JSON round-trip, fingerprint stability, the
+  committed conf/plans/ artifact matching a fresh deterministic
+  search (the --check contract, pinned in-process);
+- rejection paths: HBM-infeasible candidates never rank, reshard-
+  dirty candidates are disqualified by an injected verifier;
+- e2e: an 8-device planner->train smoke on the conftest CPU mesh
+  with loss parity against the unplanned (ad-hoc strategy) path —
+  the plan's by-name map must reproduce the exact layout the
+  strategy rules generate, step for step.
+"""
+
+import dataclasses
+import json
+import math
+import os
+
+import pytest
+
+from distributed_training_tpu.parallel import planner
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+TARGET = planner.PLAN_TARGETS["multichip_8dev"]
+
+
+# ---------------------------------------------------------------------------
+# Enumeration
+# ---------------------------------------------------------------------------
+
+
+def test_enumeration_respects_device_count():
+    cands = planner.enumerate_candidates(TARGET)
+    assert cands
+    for c in cands:
+        assert c.pp * c.dp * c.fsdp * c.sp * c.tp == TARGET.devices
+
+
+def test_enumeration_divisibility_constraints():
+    """tp bounded by head/kv/ff divisibility; sp by seq divisibility
+    and the attention impl; pp gated off by default."""
+    cands = planner.enumerate_candidates(TARGET)
+    for c in cands:
+        assert c.pp == 1  # allow_pp defaults False
+        assert TARGET.model_kwargs["n_kv_heads"] % c.tp == 0
+        assert TARGET.model_kwargs["n_heads"] % c.tp == 0
+        assert TARGET.seq_len % c.sp == 0
+    # n_kv_heads=2 bounds tp at 2 even though 4 divides n_heads.
+    assert not [c for c in cands if c.tp > 2]
+    # sp>1 exists (ring impl) ...
+    assert [c for c in cands if c.sp > 1]
+    # ... but vanishes for a non-sequence-parallel attention impl.
+    naive = dataclasses.replace(
+        TARGET, model_kwargs={**TARGET.model_kwargs,
+                              "attention_impl": "naive"})
+    assert not [c for c in planner.enumerate_candidates(naive)
+                if c.sp > 1]
+
+
+def test_enumeration_pp_gated_and_constrained():
+    t = dataclasses.replace(TARGET, allow_pp=True)
+    cands = planner.enumerate_candidates(t)
+    pps = {c.pp for c in cands}
+    assert 2 in pps  # n_layers=2 admits pp=2
+    for c in cands:
+        assert TARGET.model_kwargs["n_layers"] % c.pp == 0
+
+
+# ---------------------------------------------------------------------------
+# Cost model
+# ---------------------------------------------------------------------------
+
+
+def test_hbm_infeasible_candidates_rejected():
+    """A 1B-class model with no remat and a fat batch cannot fit a
+    v5e chip unsharded — the scorer must reject, not rank it."""
+    big = planner.PlanTarget(
+        name="big", devices=8,
+        model_kwargs=dict(vocab_size=50257, d_model=2048, n_heads=16,
+                          n_layers=24, max_seq_len=2048,
+                          dtype="bfloat16"),
+        seq_len=2048, chip="v5e", batch_candidates=(16,),
+        remat_candidates=("none",))
+    cand = planner.Candidate(1, 8, 1, 1, 1, "none", 16)
+    rec = planner.score_candidate(big, cand)
+    assert rec["feasible"] is False
+    assert rec["reason"] == "hbm"
+    # And rank_candidates drops it rather than scoring it.
+    keys = [c.key for c, _s in planner.rank_candidates(big)]
+    assert cand.key not in keys
+
+
+def test_score_prefers_no_remat_when_memory_allows():
+    """remat buys memory with recompute FLOPs: at equal feasibility
+    the scorer must prefer none > mlp_pre > mlp (the measured ladder's
+    ordering)."""
+    n_params = planner._n_params(TARGET)
+    scores = {r: planner.score_candidate(
+        TARGET, planner.Candidate(1, 1, 8, 1, 1, r, 8), n_params)
+        for r in ("none", "mlp_pre", "mlp")}
+    assert all(s["feasible"] for s in scores.values())
+    assert (scores["none"]["score"] >= scores["mlp_pre"]["score"]
+            >= scores["mlp"]["score"])
+
+
+def test_ranking_is_deterministic():
+    a = [(c.key, s["score"]) for c, s in planner.rank_candidates(TARGET)]
+    b = [(c.key, s["score"]) for c, s in planner.rank_candidates(TARGET)]
+    assert a == b
+    assert a  # non-empty
+    for _k, s in a:
+        assert math.isfinite(s)
+
+
+# ---------------------------------------------------------------------------
+# Plan artifact
+# ---------------------------------------------------------------------------
+
+
+def _stage1_plan(target=TARGET):
+    """A plan materialized without any compile (stage 1 only)."""
+    ranked = planner.rank_candidates(target)
+    return planner.build_plan(target, ranked[0][0])
+
+
+def test_plan_json_round_trip(tmp_path):
+    plan = _stage1_plan()
+    path = str(tmp_path / "p.json")
+    planner.save_plan(plan, path)
+    loaded = planner.load_plan(path)
+    assert loaded.to_doc() == json.loads(
+        json.dumps(plan.to_doc()))  # canonical-equal after round trip
+    assert loaded.fingerprint() == plan.fingerprint()
+
+
+def test_plan_fingerprint_changes_with_content():
+    plan = _stage1_plan()
+    other = dataclasses.replace(plan, remat="mlp")
+    assert other.fingerprint() != plan.fingerprint()
+
+
+def test_hand_edited_plan_refuses_to_load(tmp_path):
+    plan = _stage1_plan()
+    doc = plan.to_doc()
+    doc["batch_per_shard"] = 999  # edit without re-fingerprinting
+    p = tmp_path / "edited.json"
+    p.write_text(json.dumps(doc))
+    with pytest.raises(planner.PlanError, match="integrity"):
+        planner.load_plan(str(p))
+    # Identity edits with a refreshed digest still trip the
+    # fingerprint check.
+    doc2 = plan.to_doc()
+    doc2["batch_per_shard"] = 999
+    doc2.pop("integrity")
+    p2 = tmp_path / "edited2.json"
+    p2.write_text(json.dumps(doc2))
+    with pytest.raises(planner.PlanError, match="fingerprint"):
+        planner.load_plan(str(p2))
+
+
+def test_hand_edited_provenance_refuses_to_load(tmp_path):
+    """--check trusts the recorded disqualifications and compile
+    evidence; forging them (identity fields untouched, so the
+    fingerprint alone would pass) must refuse at load."""
+    plan = _stage1_plan()
+    plan.provenance = {"compile_evidence":
+                       {"spmd_reshard_warnings": 3}}
+    doc = plan.to_doc()
+    doc["provenance"] = {"compile_evidence":
+                         {"spmd_reshard_warnings": 0}}  # forged
+    p = tmp_path / "forged.json"
+    p.write_text(json.dumps(doc))
+    with pytest.raises(planner.PlanError, match="integrity"):
+        planner.load_plan(str(p))
+
+
+def test_committed_plan_matches_fresh_search_and_check_passes():
+    """The --check contract, in-process: the committed conf/plans/
+    artifact is byte-for-byte what the deterministic search resolves
+    today (same winner, same fingerprint), and check_plan agrees."""
+    committed = planner.load_plan(TARGET.name)
+    fresh = _stage1_plan()
+    assert committed.mesh == fresh.mesh
+    assert committed.remat == fresh.remat
+    assert committed.batch_per_shard == fresh.batch_per_shard
+    assert committed.fingerprint() == fresh.fingerprint()
+    ev = committed.provenance["compile_evidence"]
+    assert ev["spmd_reshard_warnings"] == 0
+    assert planner.check_plan(TARGET) == []
+
+
+def test_check_plan_flags_drifted_ranking(tmp_path, monkeypatch):
+    """--check fails when the committed provenance no longer matches
+    the live ranking (cost-model drift)."""
+    committed = planner.load_plan(TARGET.name)
+    doc = committed.to_doc()
+    doc["provenance"] = dict(doc["provenance"])
+    doc["provenance"]["ranking"] = doc["provenance"]["ranking"][:1]
+    doc.pop("integrity", None)  # unit-testing check_plan, not load
+    monkeypatch.setattr(
+        planner, "load_plan",
+        lambda _n: planner.Plan.from_doc(json.loads(json.dumps(doc))))
+    problems = planner.check_plan(TARGET)
+    assert problems and "ranking changed" in problems[0]
+
+
+# ---------------------------------------------------------------------------
+# Reshard-dirty candidates are disqualified
+# ---------------------------------------------------------------------------
+
+
+def test_reshard_warning_candidate_disqualified():
+    """Inject a verifier that calls the top-ranked candidate dirty:
+    the search must record the disqualification and settle on the
+    next candidate, never ship the dirty one."""
+    ranked = planner.rank_candidates(TARGET)
+    dirty_key = ranked[0][0].key
+    calls = []
+
+    def fake_verify(target, plan):
+        calls.append(plan.candidate_key)
+        dirty = plan.candidate_key == dirty_key
+        return {"spmd_reshard_warnings": 2 if dirty else 0,
+                "reshard_ops": ["gather"] if dirty else [],
+                "collective_bytes_per_step": 1,
+                "total_collectives": 1}
+
+    plan = planner.plan_search(TARGET, verify_fn=fake_verify)
+    assert calls[0] == dirty_key
+    assert plan.candidate_key == ranked[1][0].key
+    assert plan.provenance["disqualified"] == [{
+        "candidate": dirty_key, "spmd_reshard_warnings": 2,
+        "reshard_ops": ["gather"]}]
+
+
+def test_all_dirty_candidates_raise():
+    def always_dirty(_t, _p):
+        return {"spmd_reshard_warnings": 1, "reshard_ops": ["x"],
+                "collective_bytes_per_step": 0, "total_collectives": 0}
+    with pytest.raises(planner.PlanError, match="involuntary-reshard"):
+        planner.plan_search(TARGET, verify_fn=always_dirty)
+
+
+# ---------------------------------------------------------------------------
+# PlannedStrategy
+# ---------------------------------------------------------------------------
+
+
+def test_planned_strategy_matches_generator_specs():
+    """The by-name map must reproduce EXACTLY the specs the base
+    strategy's rules generate — the plan is a serialization of the
+    layout, not a reinterpretation."""
+    import jax
+
+    from distributed_training_tpu.models.transformer import (
+        Transformer)
+    from distributed_training_tpu.parallel.strategy import get_strategy
+    from distributed_training_tpu.runtime import MeshSpec
+
+    plan = planner.load_plan(TARGET.name)
+    strat = planner.PlannedStrategy(plan=plan)
+    mesh_spec = MeshSpec(**plan.mesh)
+    base = get_strategy(plan.base_strategy, mesh_spec,
+                        min_shard_elems=TARGET.min_shard_elems)
+    model = Transformer(planner._tf_cfg(TARGET, "none"))
+    shapes = jax.eval_shape(model.init, jax.random.PRNGKey(0))
+    assert (strat.specs_for_tree(shapes)
+            == base.specs_for_tree(shapes, model.logical_axes()))
+    assert strat.batch_spec() == base.batch_spec()
+    assert strat.wants_gather_for_compute == (
+        plan.base_strategy == "fsdp")
+
+
+def test_planned_strategy_unknown_path_raises():
+    import jax.numpy as jnp
+    plan = planner.load_plan(TARGET.name)
+    strat = planner.PlannedStrategy(plan=plan)
+    with pytest.raises(planner.PlanError, match="not_a_param"):
+        strat.specs_for_tree({"not_a_param": jnp.zeros((4, 4))})
+
+
+def test_apply_plan_to_config_derives_mesh_and_batch():
+    """The CLI surface: mesh axes pinned with dp as the wildcard, and
+    the plan's per-shard batch applied (it is a SEARCHED dimension —
+    the compiled program must be the one the plan's compile evidence
+    covered) unless the elastic global-batch contract owns it."""
+    from distributed_training_tpu.config import Config
+
+    plan = planner.load_plan(TARGET.name)
+    cfg = Config()
+    cfg.train.sharding_plan = TARGET.name
+    assert planner.apply_plan_to_config(cfg).fingerprint() == \
+        plan.fingerprint()
+    assert cfg.mesh.dp == -1
+    for a in ("pp", "fsdp", "sp", "tp"):
+        assert getattr(cfg.mesh, a) == plan.mesh[a]
+    assert cfg.train.batch_size == plan.batch_per_shard
+    # global_batch_size set -> elastic owns the per-shard derivation.
+    cfg2 = Config()
+    cfg2.train.sharding_plan = TARGET.name
+    cfg2.train.global_batch_size = 64
+    cfg2.train.batch_size = 5
+    planner.apply_plan_to_config(cfg2)
+    assert cfg2.train.batch_size == 5
+
+
+def test_check_plan_runtime_mesh_mismatch():
+    from distributed_training_tpu.runtime import MeshSpec
+    plan = planner.load_plan(TARGET.name)
+    good = MeshSpec(**plan.mesh)
+    planner.check_plan_runtime(plan, good, elastic=False)  # no raise
+    bad = MeshSpec(pp=1, dp=2, fsdp=2, sp=1, tp=2)
+    with pytest.raises(planner.PlanError, match="does not match plan"):
+        planner.check_plan_runtime(plan, bad, elastic=False)
+    # Elastic: ONLY dp may differ.
+    dp_flex = MeshSpec(**{**plan.mesh, "dp": max(1, plan.mesh["dp"])})
+    planner.check_plan_runtime(plan, dp_flex, elastic=True)
+    with pytest.raises(planner.PlanError, match="does not match plan"):
+        planner.check_plan_runtime(plan, bad, elastic=True)
+
+
+# ---------------------------------------------------------------------------
+# e2e: planner -> train, loss parity vs the unplanned path
+# ---------------------------------------------------------------------------
+
+
+def _tiny_trainer(rt, sharding_plan="", strategy="tp", batch=2,
+                  model_kwargs=None, tmp_path=None):
+    from distributed_training_tpu.config import Config
+    from distributed_training_tpu.data import (ShardedDataLoader,
+                                               SyntheticLMDataset)
+    from distributed_training_tpu.models import build_model
+    from distributed_training_tpu.train.trainer import Trainer
+
+    cfg = Config()
+    cfg.train.parallel_strategy = strategy
+    cfg.train.sharding_plan = sharding_plan
+    cfg.train.batch_size = batch
+    cfg.train.log_every = 0
+    cfg.train.min_shard_elems = 1
+    cfg.train.dtype = "float32"
+    cfg.train.optimizer = "adamw"
+    model = build_model("transformer", **model_kwargs)
+    ds = SyntheticLMDataset(size=64, seq_len=16, vocab_size=64, seed=0)
+    loader = ShardedDataLoader(ds, rt, batch_size=batch, shuffle=False)
+    return Trainer(cfg, rt, model, loader), loader
+
+
+def test_planner_to_train_e2e_loss_parity(tmp_path):
+    """8-device CPU end-to-end: materialize a plan for a fixed
+    fsdp=2 x tp=2 x dp=2 candidate, train 3 steps through
+    train.sharding_plan, and compare losses step-for-step against
+    the SAME layout built from the ad-hoc strategy rules. Identical
+    layout => identical compiled program => identical losses."""
+    from distributed_training_tpu.runtime import fake_cpu_runtime
+
+    mk = dict(vocab_size=64, d_model=32, n_heads=2, n_kv_heads=2,
+              n_layers=2, max_seq_len=16, dtype="float32",
+              attention_impl="naive")
+    target = planner.PlanTarget(
+        name="e2e_tiny", devices=8,
+        model_kwargs=mk, seq_len=16, optimizer="adamw",
+        batch_candidates=(2,), remat_candidates=("none",))
+    cand = planner.Candidate(1, 2, 2, 1, 2, "none", 2)
+    plan = planner.build_plan(target, cand)
+    path = str(tmp_path / "e2e_tiny.json")
+    planner.save_plan(plan, path)
+
+    def losses(sharding_plan, strategy):
+        rt = fake_cpu_runtime(8, fsdp=2, tp=2)
+        trainer, loader = _tiny_trainer(
+            rt, sharding_plan=sharding_plan, strategy=strategy,
+            model_kwargs=mk)
+        if sharding_plan:
+            assert trainer.strategy.name == "planned"
+        out = []
+        it = iter(loader.epoch(0))
+        for _ in range(3):
+            out.append(float(trainer.train_step(next(it))["loss"]))
+        return out
+
+    planned = losses(path, "tp")
+    unplanned = losses("", "tp")
+    assert planned == pytest.approx(unplanned, rel=1e-6, abs=1e-6)
+
+
+def test_trainer_rejects_plan_mesh_mismatch(tmp_path):
+    """A plan pinned against the wrong runtime mesh must fail at
+    trainer construction with the mismatch named — never compile a
+    silently different layout."""
+    from distributed_training_tpu.runtime import fake_cpu_runtime
+
+    mk = dict(vocab_size=64, d_model=32, n_heads=2, n_kv_heads=2,
+              n_layers=2, max_seq_len=16, dtype="float32",
+              attention_impl="naive")
+    target = planner.PlanTarget(
+        name="e2e_tiny", devices=8, model_kwargs=mk, seq_len=16,
+        batch_candidates=(2,), remat_candidates=("none",))
+    plan = planner.build_plan(
+        target, planner.Candidate(1, 2, 2, 1, 2, "none", 2))
+    path = str(tmp_path / "p.json")
+    planner.save_plan(plan, path)
+    rt = fake_cpu_runtime(8, fsdp=4)  # NOT the plan's mesh
+    with pytest.raises(planner.PlanError, match="does not match plan"):
+        _tiny_trainer(rt, sharding_plan=path, model_kwargs=mk)
+
+
+def test_trainer_collectives_report_carries_plan_provenance(tmp_path):
+    """The one-shot collectives event names the plan it measured —
+    and the summary surface (SUMMARY_KEYS) carries it through."""
+    import jax
+
+    from distributed_training_tpu.runtime import fake_cpu_runtime
+    from distributed_training_tpu.telemetry.collectives import (
+        SUMMARY_KEYS, summary_of_event)
+
+    mk = dict(vocab_size=64, d_model=32, n_heads=2, n_kv_heads=2,
+              n_layers=2, max_seq_len=16, dtype="float32",
+              attention_impl="naive")
+    target = planner.PlanTarget(
+        name="e2e_tiny", devices=8, model_kwargs=mk, seq_len=16,
+        batch_candidates=(2,), remat_candidates=("none",))
+    plan = planner.build_plan(
+        target, planner.Candidate(1, 2, 2, 1, 2, "none", 2))
+    path = str(tmp_path / "p.json")
+    planner.save_plan(plan, path)
+    rt = fake_cpu_runtime(8, fsdp=2, tp=2)
+    trainer, loader = _tiny_trainer(rt, sharding_plan=path,
+                                    model_kwargs=mk)
+    sample = next(iter(loader.epoch(0)))
+    batch = {k: jax.ShapeDtypeStruct(v.shape, v.dtype,
+                                     sharding=trainer.batch_sharding)
+             for k, v in sample.items()}
+    rep = trainer.collectives_report(batch)
+    assert rep["sharding_plan"]["name"] == "e2e_tiny"
+    assert rep["sharding_plan"]["fingerprint"] == plan.fingerprint()
+    assert "sharding_plan" in SUMMARY_KEYS
+    assert summary_of_event(rep)["sharding_plan"] == \
+        rep["sharding_plan"]
