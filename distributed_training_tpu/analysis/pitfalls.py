@@ -45,6 +45,12 @@ Rule catalog (details in docs/static-analysis.md):
   plans) — the single-spec-source discipline the auto-parallelism
   planner enforces. Specs DERIVED from runtime/strategy objects
   (``P(b_axes, None)``, ``P(*sh.spec[1:])``, ``P()``) stay legal.
+- DTT009 unseeded RNG in ``data/``: ``np.random.default_rng()`` bare,
+  module-level ``np.random.*`` samplers, stdlib ``random.*`` — the
+  exactly-once pipeline's position must serialize into a checkpoint
+  as integers (data/stream.py), and hidden global RNG state is
+  pipeline position that cannot, so resume silently replays or skips
+  samples.
 """
 
 from __future__ import annotations
@@ -640,6 +646,102 @@ def _check_raw_pspec(ctx: FileContext):
                    "sharding map — route the layout through "
                    "parallel/strategy.py rules or a resolved plan "
                    "(parallel/planner.py)")
+
+
+# ---------------------------------------------------------------------------
+# DTT009 — unseeded RNG state inside the data pipeline
+# ---------------------------------------------------------------------------
+
+# Scope: the data pipeline, whose whole position must round-trip
+# through checkpoint meta (data/stream.py StreamState). Models and
+# trainers draw from jax PRNG keys (DTT005's domain), not host RNGs.
+DTT009_SCOPED = (
+    os.path.join("distributed_training_tpu", "data"),
+)
+# Seeded-constructor / non-sampling names under np.random that are
+# fine: constructing a generator from explicit integers IS the
+# serializable-position discipline.
+_DTT009_NP_OK = {"default_rng", "Generator", "SeedSequence", "Philox",
+                 "PCG64", "PCG64DXSM", "MT19937", "SFC64",
+                 "BitGenerator"}
+# stdlib `random` module functions that consume the hidden global
+# generator (a conservative list — attribute chains rooted at a
+# variable named `random` don't reach here unless len == 2).
+_DTT009_STDLIB = {"random", "randint", "randrange", "uniform",
+                  "choice", "choices", "sample", "shuffle", "seed",
+                  "getrandbits", "gauss", "betavariate",
+                  "expovariate", "normalvariate", "triangular",
+                  "randbytes"}
+
+
+@_rule("DTT009", "unseeded-rng-in-data",
+       "RNG without an explicit seed inside the data pipeline")
+def _check_unseeded_rng(ctx: FileContext):
+    """``np.random.default_rng()`` with no seed, module-level
+    ``np.random.rand(...)``-style samplers, and stdlib ``random.*``
+    calls inside ``data/`` draw from hidden, unserializable RNG state
+    — pipeline position the exactly-once contract cannot checkpoint,
+    so a resume silently replays or skips samples. Every RNG in the
+    data layer must be constructed from explicit integers
+    (``default_rng([seed, stream, epoch])`` — see
+    ``data/sampler.epoch_permutation``)."""
+    if not any(ctx.rel.startswith(p + os.sep) or ctx.rel == p
+               for p in DTT009_SCOPED):
+        return
+    # Alias resolution: `from numpy.random import default_rng [as d]`
+    # and `import numpy.random as npr` must not dodge the rule.
+    from_names: dict = {}
+    module_aliases = set()
+    for node in ast.walk(ctx.tree):
+        if (isinstance(node, ast.ImportFrom)
+                and node.module == "numpy.random"):
+            for a in node.names:
+                from_names[a.asname or a.name] = a.name
+        elif isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "numpy.random" and a.asname:
+                    module_aliases.add(a.asname)
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        chain = _attr_chain(node.func)
+        if not chain:
+            continue
+        attr = chain[-1]
+        if len(chain) == 1 and chain[0] in from_names:
+            attr = from_names[chain[0]]
+            np_random = True
+        else:
+            np_random = (
+                (len(chain) >= 3 and chain[0] in ("np", "numpy")
+                 and chain[1] == "random")
+                or (len(chain) == 2 and chain[0] in module_aliases))
+        if attr == "default_rng" and (np_random or "random" in chain):
+            # A seed is "present" only as a non-None positional or
+            # keyword value; `default_rng(seed=None)` is exactly the
+            # unseeded case. A **kwargs splat is unknowable — pass.
+            def _non_none(v):
+                return not (isinstance(v, ast.Constant)
+                            and v.value is None)
+            seeded = ([a for a in node.args if _non_none(a)]
+                      or [kw for kw in node.keywords
+                          if kw.arg is None or _non_none(kw.value)])
+            if not seeded:
+                yield (node.lineno,
+                       "np.random.default_rng() without an explicit "
+                       "seed — unserializable pipeline position; "
+                       "derive the seed from config/state integers")
+        elif np_random and attr not in _DTT009_NP_OK:
+            yield (node.lineno,
+                   f"module-level np.random.{attr}(...) draws "
+                   "from hidden global RNG state — construct a "
+                   "seeded Generator instead")
+        elif (len(chain) == 2 and chain[0] == "random"
+              and chain[1] in _DTT009_STDLIB):
+            yield (node.lineno,
+                   f"stdlib random.{chain[1]}(...) draws from hidden "
+                   "global RNG state — construct a seeded "
+                   "np.random.Generator instead")
 
 
 @_rule("DTT006", "undonated-train-step",

@@ -156,6 +156,21 @@ class TrainConfig:
     loss: str = "auto"            # "auto" | "mse" | "xent" | "prob_xent"
     dataset: str = "synthetic"    # data source name
     dataset_kwargs: dict[str, Any] = field(default_factory=dict)
+    # Multi-source exactly-once streaming pipeline (data/stream.py).
+    # Non-empty switches the train loader to StreamingDataLoader:
+    # ``{name: {dataset: <registry name>, weight: W, **kwargs}}``.
+    # The pipeline's whole position (per-source cursors, mixture,
+    # packing carry) is serialized into every checkpoint, so restarts
+    # and elastic resizes resume mid-epoch exactly-once — pair with
+    # train.global_batch_size so the stream is world-size-invariant.
+    # Mutually exclusive with eval_fraction (no held-out split yet).
+    data_sources: dict[str, Any] = field(default_factory=dict)
+    # Sequence packing (streaming pipeline only): concatenate
+    # documents across boundaries into fixed blocks of pack_seq_len
+    # tokens (+1 for the next-token shift) — no padding, so
+    # tokens/step rises to the full block on ragged corpora. 0 = one
+    # row per document (sources must then share a row length).
+    pack_seq_len: int = 0
     shuffle: bool = True
     drop_last: bool = False
     max_steps_per_epoch: int = 0  # 0 → whole shard (test/bench aid)
@@ -333,12 +348,15 @@ def compose(config_dir: str, config_name: str = "config",
 
 def _is_open_path(dotted: str) -> bool:
     """Open-schema override targets need no ``+``: the ``model`` group
-    (hyperparameters are family-specific, carried via ModelConfig.kwargs)
-    and any ``*_kwargs`` mapping (e.g. train.dataset_kwargs)."""
+    (hyperparameters are family-specific, carried via ModelConfig.kwargs),
+    any ``*_kwargs`` mapping (e.g. train.dataset_kwargs), and the
+    ``train.data_sources`` mixture tree (source names and their
+    dataset kwargs are user-defined)."""
     parts = dotted.split(".")
     if parts[0] == "model" and len(parts) > 1:
         return True
-    return any(p.endswith("_kwargs") for p in parts[:-1])
+    return any(p.endswith("_kwargs") or p == "data_sources"
+               for p in parts[:-1])
 
 
 # ---------------------------------------------------------------------------

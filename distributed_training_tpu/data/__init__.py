@@ -21,3 +21,9 @@ from distributed_training_tpu.data.loader import (  # noqa: F401
 from distributed_training_tpu.data.sampler import (  # noqa: F401
     DistributedShardSampler,
 )
+from distributed_training_tpu.data.stream import (  # noqa: F401
+    StreamSource,
+    StreamState,
+    StreamingDataLoader,
+    build_stream_sources,
+)

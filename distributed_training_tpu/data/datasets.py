@@ -120,6 +120,47 @@ class SyntheticImageDataset(ArrayDataset):
         self.num_classes = num_classes
 
 
+class SyntheticDocDataset:
+    """Variable-length synthetic token DOCUMENTS (ragged, stored as one
+    flat token array + offsets) — the shape real pretraining corpora
+    have before packing. Row ``i`` is a doc of ``min_len..max_len``
+    tokens; the streaming packer (data/stream.py) reads docs exactly
+    via ``doc(i)`` and concatenates them into fixed blocks.
+
+    ``batch`` keeps the map-style contract for probes by zero-padding
+    to the corpus max length — training should consume this dataset
+    through the packer, which never pads."""
+
+    def __init__(self, size: int = 256, min_len: int = 16,
+                 max_len: int = 96, vocab_size: int = 50257,
+                 seed: int = 0):
+        if not 0 < min_len <= max_len:
+            raise ValueError(
+                f"need 0 < min_len <= max_len, got {min_len}..{max_len}")
+        rng = np.random.default_rng([seed, 0x0D0C])
+        lengths = rng.integers(min_len, max_len + 1, size)
+        self._offsets = np.concatenate(
+            [[0], np.cumsum(lengths)]).astype(np.int64)
+        self._tokens = rng.integers(
+            0, vocab_size, int(self._offsets[-1]), dtype=np.int32)
+        self._size = size
+        self.vocab_size = vocab_size
+        self.max_len = max_len
+
+    def __len__(self) -> int:
+        return self._size
+
+    def doc(self, i: int) -> np.ndarray:
+        return self._tokens[self._offsets[i]:self._offsets[i + 1]]
+
+    def batch(self, indices: np.ndarray) -> dict[str, np.ndarray]:
+        out = np.zeros((len(indices), self.max_len), dtype=np.int32)
+        for r, i in enumerate(np.asarray(indices)):
+            d = self.doc(int(i))
+            out[r, :len(d)] = d
+        return {"tokens": out}
+
+
 class MemmapTokenDataset:
     """Token corpus over a flat binary file of token ids (np.memmap), the
     standard 'tokenized shard on shared storage' layout for real LM
@@ -208,6 +249,7 @@ def build_dataset(name: str, _defaults: dict | None = None,
         "synthetic_linear": lambda **kw: SyntheticRegressionDataset(
             kind="linear", **kw),
         "synthetic_lm": SyntheticLMDataset,
+        "synthetic_doc": SyntheticDocDataset,
         "synthetic_images": SyntheticImageDataset,
         "memmap_tokens": MemmapTokenDataset,
         # Byte-level LM over ANY local file: the zero-dependency real-

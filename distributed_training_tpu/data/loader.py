@@ -39,6 +39,43 @@ logger = logging.getLogger(__name__)
 TRANSIENT_DATA_ERRORS = (OSError,)
 
 
+def retry_transient(assemble, *, retries: int, rollback=None,
+                    **event_fields):
+    """Run one batch assembly with a bounded transient-failure budget
+    — THE retry policy, shared by both loaders so their recovery
+    behavior cannot drift.
+
+    A single IO blip (network filesystem hiccup, object-store 5xx)
+    must not kill a step loop that a supervisor would then pay a whole
+    restart-and-resume cycle for: retry ``retries`` times with short
+    exponential backoff, emitting a ``data_retry`` telemetry event per
+    attempt (``event_fields`` carry the caller's position vocabulary),
+    then re-raise — a blip that persists IS an incident and should
+    surface. ``rollback`` (if given) runs before each retry so a
+    stateful assembler restarts the batch from its pre-batch snapshot
+    and a retried batch is bit-identical to an untried one."""
+    attempt = 0
+    while True:
+        try:
+            return assemble()
+        except TRANSIENT_DATA_ERRORS as e:
+            if rollback is not None:
+                rollback()
+            attempt += 1
+            if attempt > retries:
+                raise
+            delay = min(2.0, 0.05 * 2 ** (attempt - 1))
+            logger.warning(
+                "transient data error (attempt %d/%d, retrying "
+                "in %.2fs): %s: %s", attempt, retries, delay,
+                type(e).__name__, e)
+            telemetry.event(
+                "data_retry", attempt=attempt, retries=retries,
+                backoff_s=delay, error=f"{type(e).__name__}: {e}",
+                **event_fields)
+            time.sleep(delay)
+
+
 class ShardedDataLoader:
     """Epoch-based loader yielding dicts of globally-sharded jax.Arrays.
 
@@ -76,6 +113,112 @@ class ShardedDataLoader:
         # and the deterministic fault hook (resilience/faults.py).
         self.data_retries = data_retries
         self._faults = fault_injector
+        # Checkpointable position (exactly-once contract, docs/data.md):
+        # (epoch, batches CONSUMED within it) — committed as the
+        # consumer takes each batch, so a save at any loop point
+        # records exactly what the optimizer has seen. ``_resume``
+        # holds a restored position until the matching epoch() call
+        # picks it up mid-epoch.
+        self._position = (0, 0)
+        self._resume: tuple[int, int] | None = None
+        # Column names/shapes/dtypes, learned from the first probe and
+        # cached — re-probing row 0 every step doubles IO on a
+        # remote/memmap source for information that cannot change.
+        self._col_spec: dict | None = None
+
+    # -- checkpointable position -------------------------------------------
+
+    def state_dict(self) -> dict:
+        """Serializable pipeline position (rides checkpoint meta under
+        the integrity manifest). ``samples_consumed`` counts global
+        rows handed to the trainer — what the recovery table's
+        replayed/skipped columns are derived from."""
+        epoch, step = self._position
+        if step >= self.steps_per_epoch:
+            epoch, step = epoch + 1, 0
+        return {
+            "schema": 1,
+            "impl": "sharded",
+            "seed": self.sampler.seed,
+            "epoch": epoch,
+            "step_in_epoch": step,
+            "steps_per_epoch": self.steps_per_epoch,
+            "num_shards": self.num_shards,
+            "batch_size": self.batch_size,
+            "shuffle": self.sampler.shuffle,
+            "samples_consumed": (epoch * self.steps_per_epoch + step)
+            * self.global_batch,
+            # Lets the resume fallback distinguish "mid-epoch save
+            # with unusable offset" (replay the epoch) from "epoch
+            # boundary" (start the next) without trusting the offset.
+            "mid_epoch": step > 0,
+        }
+
+    def load_state_dict(self, d) -> None:
+        if d.get("schema") != 1 or d.get("impl") != "sharded":
+            raise ValueError(
+                f"unsupported loader state (schema={d.get('schema')!r}, "
+                f"impl={d.get('impl')!r})")
+        if d.get("shuffle") not in (None, self.sampler.shuffle):
+            # shuffle=True/False pick different per-epoch orders (a
+            # permutation vs arange) — same failure class as a seed
+            # change: the offset would index a different stream.
+            raise ValueError(
+                f"checkpointed loader shuffle={d.get('shuffle')} != "
+                f"configured {self.sampler.shuffle} — the epoch orders "
+                "diverge; positions are not transferable")
+        if int(d.get("seed", self.sampler.seed)) != self.sampler.seed:
+            # A changed seed reshuffles every epoch: resuming mid-epoch
+            # at the saved OFFSET of a different permutation would
+            # silently skip/replay rows while the cursor math still
+            # claims exactly-once. Fail; the trainer falls back to an
+            # epoch-boundary resume (honest: the replay count shows).
+            raise ValueError(
+                f"checkpointed loader seed {d.get('seed')} != "
+                f"configured {self.sampler.seed} — the permutations "
+                "diverge; mid-epoch offsets are not transferable")
+        epoch, step = int(d["epoch"]), int(d["step_in_epoch"])
+        for field_name, current in (
+                ("steps_per_epoch", self.steps_per_epoch),
+                ("num_shards", self.num_shards),
+                ("batch_size", self.batch_size)):
+            saved = d.get(field_name)
+            if saved not in (None, current) and step > 0:
+                # Epoch geometry changed across the restart (elastic
+                # world resize with the legacy strided deal, batch /
+                # max_steps override): the per-epoch row->(shard,
+                # step) deal is a function of all three, so the
+                # mid-epoch offset no longer names the same rows —
+                # even when steps_per_epoch happens to coincide.
+                # Raising routes the trainer to its mid-epoch
+                # fallback, which REPLAYS the interrupted epoch
+                # (skipping its unconsumed remainder would silently
+                # drop data; the replay count reports honestly).
+                # Boundary positions (step 0) survive geometry
+                # changes: epoch starts are well-defined at any world
+                # size. The multi-source stream loader has no such
+                # restriction — its global order is world-invariant.
+                raise ValueError(
+                    f"loader {field_name} changed {saved} -> {current} "
+                    "across restart; the mid-epoch offset is not "
+                    "transferable")
+        self._position = (epoch, step)
+        self._resume = (epoch, step)
+
+    @property
+    def resume_epoch(self) -> int:
+        """The epoch the current position falls in (what the trainer
+        resumes INTO; mid-epoch positions land inside it)."""
+        epoch, step = self._position
+        return epoch + 1 if step >= self.steps_per_epoch else epoch
+
+    def seek_epoch(self, epoch: int) -> None:
+        """Position the loader at an epoch boundary — the resume
+        fallback for checkpoints that carry no (usable) loader state:
+        epoch starts are well-defined without one because the
+        per-epoch order is a pure function of ``(seed, epoch)``."""
+        self._position = (epoch, 0)
+        self._resume = (epoch, 0)
 
     def _epoch_shard_orders(self, epoch: int) -> np.ndarray:
         """(num_shards, num_samples) index matrix for this epoch, with
@@ -94,12 +237,16 @@ class ShardedDataLoader:
         """Build the global sharded batch from per-shard row indices."""
         sharding = self.runtime.batch_sharding
         b = self.batch_size
-        # Probe one row to learn column names/shapes/dtypes without
-        # materializing anything remote.
-        probe = self.dataset.batch(rows_by_shard[:1, 0])
+        if self._col_spec is None:
+            # Probe one row ONCE to learn column names/shapes/dtypes
+            # without materializing anything remote; the spec cannot
+            # change within a dataset, so it is cached for the run.
+            probe = self.dataset.batch(rows_by_shard[:1, 0])
+            self._col_spec = {name: col.shape[1:]
+                              for name, col in probe.items()}
         out: dict[str, jax.Array] = {}
-        for name, col in probe.items():
-            global_shape = (self.global_batch,) + col.shape[1:]
+        for name, tail in self._col_spec.items():
+            global_shape = (self.global_batch,) + tuple(tail)
 
             def cb(index, *, _name=name):
                 rows = index[0]
@@ -118,52 +265,46 @@ class ShardedDataLoader:
     def _assemble_with_retry(self, rows_by_shard: np.ndarray, *,
                              epoch: int, step_in_epoch: int
                              ) -> dict[str, jax.Array]:
-        """``_assemble`` with a bounded transient-failure budget.
-
-        A single IO blip (network filesystem hiccup, object-store 5xx)
-        must not kill a step loop that a supervisor would then pay a
-        whole restart-and-resume cycle for: retry ``data_retries``
-        times with short exponential backoff, emitting a ``data_retry``
-        telemetry event per attempt, then re-raise (a blip that
-        persists IS an incident and should surface).
+        """``_assemble`` under the shared ``retry_transient`` policy.
 
         The deterministic fault hook runs INSIDE the retried block, so
         an injected transient (``data_error@N``) exercises exactly the
         real recovery path. The hook's step key is the loader's own
         deterministic batch counter (``epoch * steps_per_epoch +
-        step_in_epoch + 1`` — the optimizer's global step whenever
-        epochs are replayed from their start, which is how the trainer
-        resumes)."""
+        step_in_epoch + 1``) — the optimizer's global step: since the
+        restored cursor makes a resumed epoch continue at its saved
+        ``step_in_epoch`` (never replay from the epoch start), the key
+        is derived from the same position the checkpoint carries."""
         fault_step = epoch * self.steps_per_epoch + step_in_epoch + 1
-        attempt = 0
-        while True:
-            try:
-                if self._faults is not None:
-                    self._faults.on_data(fault_step)
-                return self._assemble(rows_by_shard)
-            except TRANSIENT_DATA_ERRORS as e:
-                attempt += 1
-                if attempt > self.data_retries:
-                    raise
-                delay = min(2.0, 0.05 * 2 ** (attempt - 1))
-                logger.warning(
-                    "transient data error (attempt %d/%d, retrying "
-                    "in %.2fs): %s: %s", attempt, self.data_retries,
-                    delay, type(e).__name__, e)
-                telemetry.event(
-                    "data_retry", attempt=attempt,
-                    retries=self.data_retries, epoch=epoch,
-                    step_in_epoch=step_in_epoch, backoff_s=delay,
-                    error=f"{type(e).__name__}: {e}")
-                time.sleep(delay)
+
+        def assemble():
+            if self._faults is not None:
+                self._faults.on_data(fault_step)
+            return self._assemble(rows_by_shard)
+
+        return retry_transient(assemble, retries=self.data_retries,
+                               epoch=epoch, step_in_epoch=step_in_epoch)
 
     def epoch(self, epoch: int) -> Iterator[Mapping[str, jax.Array]]:
         """Iterate one epoch's batches (device-sharded), with background
-        host-side prefetch replacing DataLoader worker processes."""
+        host-side prefetch replacing DataLoader worker processes.
+
+        A restored position (``load_state_dict``) makes the MATCHING
+        epoch start mid-epoch at the saved batch offset — the
+        exactly-once resume: the per-epoch order is a pure function of
+        ``(seed, epoch, num_shards)``, so the remaining batches are
+        identical to the uninterrupted run's tail. The consumed
+        position commits as the consumer takes each batch; closing the
+        iterator early (preemption, eviction) stops and joins the
+        prefetch worker."""
+        start = 0
+        if self._resume is not None and self._resume[0] == epoch:
+            start = min(self._resume[1], self.steps_per_epoch)
+        self._resume = None
         orders = self._epoch_shard_orders(epoch)
 
         def produce():
-            for step in range(self.steps_per_epoch):
+            for step in range(start, self.steps_per_epoch):
                 sl = slice(step * self.batch_size,
                            (step + 1) * self.batch_size)
                 # Event-stream-only span (it runs in the prefetch
@@ -177,10 +318,19 @@ class ShardedDataLoader:
                         orders[:, sl], epoch=epoch, step_in_epoch=step)
                 yield batch
 
-        if self.prefetch_depth > 0:
-            yield from _prefetch(produce(), self.prefetch_depth)
-        else:
-            yield from produce()
+        it = (_prefetch(produce(), self.prefetch_depth)
+              if self.prefetch_depth > 0 else produce())
+        step = start
+        try:
+            for batch in it:
+                step += 1
+                self._position = (epoch, step)
+                yield batch
+            self._position = (epoch + 1, 0)
+        finally:
+            close = getattr(it, "close", None)
+            if close is not None:
+                close()
 
     def __len__(self) -> int:
         return self.steps_per_epoch
@@ -192,26 +342,57 @@ def _prefetch(it: Iterator, depth: int) -> Iterator:
     The host-side analogue of DataLoader's worker+pin_memory pipelining
     (reference: src/distributed_trainer.py:206-208): batch assembly and
     H2D transfer overlap with device compute.
-    """
+
+    A consumer that stops early (preemption mid-epoch, an epoch cap,
+    a crash unwinding the stack) must not strand the worker blocked
+    forever on ``q.put`` holding dataset/native-gather resources: the
+    worker's puts are stop-aware, and the generator's ``finally``
+    (run by ``close()`` or GC) signals stop, drains the queue, closes
+    the producer generator, and JOINS the thread."""
     q: queue.Queue = queue.Queue(maxsize=depth)
     _END = object()
+    stop = threading.Event()
     err: list[BaseException] = []
+
+    def put(item) -> bool:
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.05)
+                return True
+            except queue.Full:
+                continue
+        return False
 
     def worker():
         try:
             for item in it:
-                q.put(item)
+                if not put(item):
+                    return
         except BaseException as e:  # propagate into consumer
             err.append(e)
         finally:
-            q.put(_END)
+            close = getattr(it, "close", None)
+            if close is not None:
+                close()
+            put(_END)
 
-    t = threading.Thread(target=worker, daemon=True)
+    t = threading.Thread(target=worker, name="data-prefetch",
+                         daemon=True)
     t.start()
-    while True:
-        item = q.get()
-        if item is _END:
-            if err:
-                raise err[0]
-            return
-        yield item
+    try:
+        while True:
+            item = q.get()
+            if item is _END:
+                if err:
+                    raise err[0]
+                return
+            yield item
+    finally:
+        stop.set()
+        # Unblock a put-in-flight so the join below cannot hang.
+        try:
+            while True:
+                q.get_nowait()
+        except queue.Empty:
+            pass
+        t.join(timeout=5.0)

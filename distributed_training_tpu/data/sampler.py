@@ -25,6 +25,22 @@ from __future__ import annotations
 import numpy as np
 
 
+def epoch_permutation(seed: int, epoch: int, n: int,
+                      shuffle: bool = True,
+                      stream: int = 0) -> np.ndarray:
+    """Deterministic per-epoch permutation of ``range(n)`` as a PURE
+    FUNCTION of ``(seed, stream, epoch)`` — the counter-keyed RNG
+    discipline the streaming pipeline (data/stream.py) is built on:
+    position is always ``(integers, cursor)``, never a live generator
+    object, so pipeline state serializes into a checkpoint. ``stream``
+    namespaces independent sequences (one per mixture source) under
+    one seed."""
+    if not shuffle:
+        return np.arange(n)
+    rng = np.random.default_rng([seed, stream, epoch])
+    return rng.permutation(n)
+
+
 class DistributedShardSampler:
     """Yields per-shard index arrays for one epoch."""
 

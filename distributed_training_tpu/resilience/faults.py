@@ -12,8 +12,9 @@ Grammar (docs/robustness.md)::
     plan    := entry ("," entry)*
     entry   := kind "@" step (":" modifier)*
     kind    := crash | sigterm | corrupt_ckpt | data_stall | data_error
-             | lose_host | slow_host
-    modifier:= "always" | duration | "host=" K   # duration: "500ms"
+             | data_corrupt | source_stall | lose_host | slow_host
+    modifier:= "always" | duration | "host=" K    # duration: "500ms"
+             | "source=" NAME | "skip" | "fatal"  # source-level kinds
 
 - ``crash@40``        raise ``InjectedCrash`` after step 40 completes
   (hard failure: no final save; recovery = supervisor restart +
@@ -27,6 +28,21 @@ Grammar (docs/robustness.md)::
   (exercises data_wait accounting and the hang watchdog).
 - ``data_error@60``   raise a transient ``InjectedDataError`` in batch
   assembly at step 60 (exercises the loader's bounded retry).
+- ``data_corrupt@60:source=wiki:skip`` the first sample read from
+  source ``wiki`` at or after step 60 raises ``InjectedCorruptData``
+  — a VALIDATION failure, not an IO blip, so it is never retried
+  (at-or-after, the ``corrupt_ckpt`` precedent: the mixture may
+  assemble the exact batch without touching the named source).
+  Policy ``skip`` (the default) exercises the streaming pipeline's
+  skip-and-record path (``data_skip`` event with the (source,
+  sample_id), ``StreamState.skipped`` counter); ``fatal`` propagates
+  and kills the run (recovery = supervisor restart; the ledger keeps
+  it one-shot). ``source=`` optional — the first read of any source
+  takes the hit when omitted.
+- ``source_stall@60:500ms:source=wiki`` sleep 500ms in the first
+  read of source ``wiki`` at or after step 60 (a single slow source
+  must show up in data_wait attribution without stalling the other
+  sources' cursor arithmetic).
 - ``lose_host@40:host=2`` host 2 dies WITHOUT CLEANUP
   (``os._exit``) after step 40 — the machine-reclaimed shape; no
   sentinel, no final save. Exercises the launcher's lost-host
@@ -72,14 +88,20 @@ from distributed_training_tpu.resilience.elastic import (
 logger = logging.getLogger(__name__)
 
 KINDS = ("crash", "sigterm", "corrupt_ckpt", "data_stall", "data_error",
-         "lose_host", "slow_host")
+         "data_corrupt", "source_stall", "lose_host", "slow_host")
 # Kinds that target one host (require a host= modifier).
 HOST_KINDS = ("lose_host", "slow_host")
+# Kinds that act inside a single mixture source's read path (accept a
+# source= modifier; data/stream.py's per-doc hook evaluates them).
+SOURCE_KINDS = ("data_corrupt", "source_stall")
+# data_corrupt recovery policies (see InjectedCorruptData).
+CORRUPT_POLICIES = ("skip", "fatal")
 
 _ENTRY_RE = re.compile(r"^(?P<kind>[a-z_]+)@(?P<step>\d+)"
-                       r"(?P<mods>(?::[A-Za-z0-9.=]+)*)$")
+                       r"(?P<mods>(?::[A-Za-z0-9._=-]+)*)$")
 _DURATION_RE = re.compile(r"^(?P<num>\d+(?:\.\d+)?)(?P<unit>ms|s)$")
 _HOST_RE = re.compile(r"^host=(?P<host>\d+)$")
+_SOURCE_RE = re.compile(r"^source=(?P<source>[A-Za-z0-9._-]+)$")
 
 
 class FaultPlanError(ValueError):
@@ -98,6 +120,20 @@ class InjectedDataError(OSError):
     like a real IO blip."""
 
 
+class InjectedCorruptData(ValueError):
+    """A scheduled VALIDATION failure in one source's sample read
+    (``data_corrupt@N``). Subclasses ValueError — corrupt bytes do not
+    improve on a retry, so the loader's transient-retry path must not
+    touch it. ``corrupt_policy`` is the duck-typed attribute the
+    streaming pipeline keys its skip-and-record vs. fatal handling on
+    (shared with data/stream.py's ``CorruptSampleError`` so injected
+    and real corruption recover through the same code path)."""
+
+    def __init__(self, msg: str, policy: str = "skip"):
+        super().__init__(msg)
+        self.corrupt_policy = policy
+
+
 def parse_duration_s(text: str) -> float:
     m = _DURATION_RE.match(text)
     if not m:
@@ -114,14 +150,20 @@ class Fault:
     always: bool = False
     stall_s: float = 0.0
     host: int | None = None
+    source: str | None = None
+    policy: str = ""
 
     @property
     def key(self) -> str:
-        """Ledger identity. Deliberately excludes tuning modifiers:
-        the plan is config, the (kind, step[, host]) tuple is the
-        scheduled incident."""
+        """Ledger identity. Deliberately excludes tuning modifiers
+        (durations, policies): the plan is config, the (kind, step
+        [, host][, source]) tuple is the scheduled incident."""
         base = f"{self.kind}@{self.step}"
-        return base if self.host is None else f"{base}:host={self.host}"
+        if self.host is not None:
+            base += f":host={self.host}"
+        if self.source is not None:
+            base += f":source={self.source}"
+        return base
 
 
 def parse_fault_plan(spec: str) -> tuple[Fault, ...]:
@@ -149,20 +191,29 @@ def parse_fault_plan(spec: str) -> tuple[Fault, ...]:
         always = False
         stall_s = 0.0
         host: int | None = None
+        source: str | None = None
+        policy = ""
         mods = [t for t in m.group("mods").split(":") if t]
         for tok in mods:
             hm = _HOST_RE.match(tok)
+            sm = _SOURCE_RE.match(tok)
             if tok == "always":
                 always = True
+            elif tok in CORRUPT_POLICIES:
+                policy = tok
             elif hm:
                 host = int(hm.group("host"))
+            elif sm:
+                source = sm.group("source")
             else:
                 stall_s = parse_duration_s(tok)
-        if stall_s and kind not in ("data_stall", "slow_host"):
+        if stall_s and kind not in ("data_stall", "slow_host",
+                                    "source_stall"):
             raise FaultPlanError(
                 f"duration modifier only applies to data_stall/"
-                f"slow_host, got {entry!r}")
-        if kind in ("data_stall", "slow_host") and not stall_s:
+                f"slow_host/source_stall, got {entry!r}")
+        if kind in ("data_stall", "slow_host", "source_stall") \
+                and not stall_s:
             raise FaultPlanError(
                 f"{kind} needs a duration, e.g. "
                 f"'{kind}@{step}:500ms' (got {entry!r})")
@@ -174,13 +225,40 @@ def parse_fault_plan(spec: str) -> tuple[Fault, ...]:
             raise FaultPlanError(
                 f"{kind} needs a target, e.g. "
                 f"'{kind}@{step}:host=2' (got {entry!r})")
+        if source is not None and kind not in SOURCE_KINDS:
+            raise FaultPlanError(
+                f"source= modifier only applies to "
+                f"{'/'.join(SOURCE_KINDS)}, got {entry!r}")
+        if policy and kind != "data_corrupt":
+            raise FaultPlanError(
+                f"skip/fatal policy only applies to data_corrupt, "
+                f"got {entry!r}")
         f = Fault(kind=kind, step=step, always=always, stall_s=stall_s,
-                  host=host)
+                  host=host, source=source, policy=policy)
         if f.key in seen:
             raise FaultPlanError(f"duplicate fault {f.key!r}")
         seen.add(f.key)
         faults.append(f)
     return tuple(faults)
+
+
+def check_plan_hooks(plan: tuple[Fault, ...],
+                     has_stream_sources: bool) -> None:
+    """Fail at wiring time when a plan schedules faults whose hook
+    point the configured pipeline never calls: source-level kinds
+    fire from the streaming loader's per-document read
+    (``on_source``), which ``ShardedDataLoader`` does not have — a
+    drill that silently never fires would exit 0 and validate
+    nothing."""
+    if has_stream_sources:
+        return
+    dead = [f.key for f in plan if f.kind in SOURCE_KINDS]
+    if dead:
+        raise FaultPlanError(
+            f"fault(s) {dead} are source-level "
+            f"({'/'.join(SOURCE_KINDS)}) but the run has no "
+            "train.data_sources — the sharded loader never reads "
+            "per-source, so they would silently never fire")
 
 
 def corrupt_step_dir(step_dir: str, nbytes: int = 64) -> str | None:
@@ -328,6 +406,39 @@ class FaultInjector:
             self._record(f)
             raise InjectedDataError(
                 f"injected transient data error at step {step}")
+
+    def _due_source(self, step: int, source: str,
+                    kinds: tuple[str, ...]) -> list[Fault]:
+        """Source-level due check: fires at the FIRST matching read at
+        or after the scheduled step (the ``corrupt_ckpt`` precedent —
+        an exact-step match would silently never fire when the
+        mixture happens to assemble that batch without touching the
+        named source). Deterministic: the stream's read sequence is a
+        pure function of its state on every host."""
+        return [f for f in self.plan
+                if f.kind in kinds and step >= f.step
+                and (f.source is None or f.source == source)
+                and (f.always or f.key not in self.fired)]
+
+    def on_source(self, step: int, source: str) -> None:
+        """Source-level read path (data/stream.py), once per document
+        read ATTEMPT. ``step`` is the loader's deterministic batch
+        counter; a fault carrying ``source=`` acts on the named
+        source's first read at or after its step — an unqualified one
+        hits the first read of any source. The ledger write precedes
+        the raise, so a ``fatal`` corruption that kills the run is
+        one-shot across restarts."""
+        for f in self._due_source(step, source, ("source_stall",)):
+            self._record(f, source=source, stall_s=f.stall_s,
+                         fired_at=step)
+            time.sleep(f.stall_s)
+        for f in self._due_source(step, source, ("data_corrupt",)):
+            policy = f.policy or "skip"
+            self._record(f, source=source, policy=policy,
+                         fired_at=step)
+            raise InjectedCorruptData(
+                f"injected corrupt sample in source {source!r} at "
+                f"step {step}", policy=policy)
 
     def on_checkpoint_saved(self, step: int,
                             directory: str | None = None) -> None:

@@ -174,6 +174,30 @@ def _recovery(events: list[dict]) -> dict | None:
             "time_to_recover_s": gap,
             "restarts": (cur["resume"] or {}).get("restarts"),
         }
+        # Exactly-once columns (docs/data.md): the resume event
+        # carries the restored pipeline cursor; relative to the
+        # restored optimizer step, every divergence is either a
+        # replay (cursor behind step * global_batch — the optimizer
+        # will re-consume samples it already saw) or a skip (cursor
+        # ahead). Both must be 0 for a loader whose state rides the
+        # checkpoint; the legacy epoch-replay resume shows its replay
+        # count here honestly. Additive keys — consumers of the old
+        # incident shape are unaffected.
+        cursor = cur["resume"].get("samples_consumed")
+        gb = cur["resume"].get("global_batch")
+        if (isinstance(cursor, int) and isinstance(gb, int)
+                and isinstance(resume_step, int)):
+            expected = resume_step * gb
+            incident["samples_replayed"] = max(0, expected - cursor)
+            incident["samples_skipped"] = max(0, cursor - expected)
+        realized = cur["resume"].get("realized_mixture")
+        target = cur["resume"].get("target_mixture")
+        if isinstance(realized, dict) and isinstance(target, dict):
+            incident["mixture_drift"] = round(max(
+                (abs(float(realized.get(k, 0.0))
+                     - float(target.get(k, 0.0)))
+                 for k in set(realized) | set(target)),
+                default=0.0), 6)
         old_w, new_w = _segment_world(prev), _segment_world(cur)
         if (isinstance(old_w, int) and isinstance(new_w, int)
                 and old_w != new_w):
@@ -192,9 +216,13 @@ def _recovery(events: list[dict]) -> dict | None:
     retries = [e for e in events if e.get("kind") == "data_retry"]
     evictions = [e for e in events
                  if e.get("kind") == "eviction_request"]
+    # Deliberate skip-and-record corrupt-sample skips (data/stream.py
+    # ``data_skip`` events) — distinct from the incident-level
+    # samples_skipped column, which measures RESUME skips.
+    skips = [e for e in events if e.get("kind") == "data_skip"]
     elastic = [i for i in incidents if "new_world" in i]
     if not incidents and not quarantined and not faults \
-            and not retries and not evictions:
+            and not retries and not evictions and not skips:
         return None
     return {
         "restarts": len(incidents),
@@ -208,6 +236,9 @@ def _recovery(events: list[dict]) -> dict | None:
              "metric": e.get("metric"), "ratio": e.get("ratio")}
             for e in evictions],
         "data_retries": len(retries),
+        "data_skips": [
+            {"source": e.get("source"), "sample_id": e.get("sample_id"),
+             "step": e.get("step")} for e in skips],
     }
 
 
@@ -256,13 +287,16 @@ def render_recovery_lines(rec: dict) -> list[str]:
     multi-host aggregate so the two renderings cannot drift. Elastic
     incidents (world resizes) annotate their incident line with the
     old→new world size; eviction requests get their own lines."""
+    skips = rec.get("data_skips") or []
     lines = [
         f"recovery: {rec['restarts']} restart(s), "
         f"{len(rec['quarantined'])} checkpoint(s) quarantined, "
         f"{rec['data_retries']} data retr"
         f"{'y' if rec['data_retries'] == 1 else 'ies'}"
         + (f", {len(rec['elastic'])} elastic resize(s)"
-           if rec.get("elastic") else "")]
+           if rec.get("elastic") else "")
+        + (f", {len(skips)} corrupt sample(s) skipped"
+           if skips else "")]
     for i, inc in enumerate(rec["incidents"]):
         ttr = inc.get("time_to_recover_s")
         lost = inc.get("steps_lost")
@@ -272,6 +306,13 @@ def render_recovery_lines(rec: dict) -> list[str]:
             + (f" ({lost} step(s) lost)" if lost is not None else "")
             + (f", recovered in {ttr:.1f}s" if ttr is not None
                else ""))
+        if "samples_replayed" in inc:
+            # The exactly-once proof line: a loader whose state rides
+            # the checkpoint reports 0 / 0 here.
+            line += (f", {inc['samples_replayed']} sample(s) replayed"
+                     f" / {inc.get('samples_skipped', 0)} skipped")
+        if inc.get("mixture_drift") is not None:
+            line += f", mixture drift {inc['mixture_drift']:.4f}"
         if "new_world" in inc:
             line += (f", world {inc.get('old_world')} -> "
                      f"{inc['new_world']}")
@@ -288,6 +329,10 @@ def render_recovery_lines(rec: dict) -> list[str]:
     for q in rec["quarantined"]:
         lines.append(f"  QUARANTINED step {q.get('step')}: "
                      f"{q.get('path')}")
+    for s in skips:
+        lines.append(
+            f"  SKIPPED corrupt sample {s.get('source')}"
+            f"[{s.get('sample_id')}] at step {s.get('step')}")
     if rec["faults_injected"]:
         lines.append("  faults injected: "
                      + ", ".join(map(str, rec["faults_injected"])))

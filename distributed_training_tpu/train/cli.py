@@ -119,39 +119,71 @@ def main(argv: list[str] | None = None) -> int:
     fault_injector = None
     if cfg.train.fault_plan:
         from distributed_training_tpu.resilience import faults
+        plan = faults.parse_fault_plan(cfg.train.fault_plan)
+        # Source-level kinds need the streaming loader's per-document
+        # hook; scheduling them against the sharded loader would be a
+        # drill that silently never fires.
+        faults.check_plan_hooks(plan, bool(cfg.train.data_sources))
         fault_injector = faults.FaultInjector(
-            faults.parse_fault_plan(cfg.train.fault_plan),
+            plan,
             ledger_path=os.path.join(host_dir, "faults_fired.json"),
             ckpt_dir=cfg.train.snapshot_path,
             host=rt.process_index)
 
-    dataset = build_dataset(
-        cfg.train.dataset,
-        _defaults={"size": cfg.train.dataset_size,
-                   "seed": cfg.train.seed},
-        **cfg.train.dataset_kwargs,
-    )
     eval_loader = None
-    if cfg.train.eval_fraction > 0:
-        from distributed_training_tpu.data.datasets import (
-            train_eval_split,
+    if cfg.train.data_sources:
+        # Multi-source exactly-once streaming pipeline (data/
+        # stream.py): the loader's whole position rides the
+        # checkpoint, so restarts and elastic resizes resume
+        # mid-epoch without replaying or skipping a sample.
+        from distributed_training_tpu.data import (StreamingDataLoader,
+                                                   build_stream_sources)
+        if cfg.train.eval_fraction > 0:
+            raise ValueError(
+                "train.eval_fraction is not supported with "
+                "train.data_sources (the stream has no held-out "
+                "split); set eval_fraction=0")
+        sources = build_stream_sources(
+            cfg.train.data_sources,
+            defaults={"size": cfg.train.dataset_size,
+                      "seed": cfg.train.seed})
+        loader = StreamingDataLoader(
+            sources, rt,
+            batch_size=cfg.train.batch_size,
+            pack_len=cfg.train.pack_seq_len,
+            shuffle=cfg.train.shuffle,
+            seed=cfg.train.seed,
+            steps_per_epoch=cfg.train.max_steps_per_epoch,
+            data_retries=cfg.train.data_retries,
+            fault_injector=fault_injector,
         )
-        dataset, eval_ds = train_eval_split(
-            dataset, cfg.train.eval_fraction, seed=cfg.train.seed,
-            multiple_of=cfg.train.batch_size * rt.data_shard_count)
-        eval_loader = ShardedDataLoader(
-            eval_ds, rt, batch_size=cfg.train.batch_size,
-            shuffle=False, seed=cfg.train.seed)
-    loader = ShardedDataLoader(
-        dataset, rt,
-        batch_size=cfg.train.batch_size,
-        shuffle=cfg.train.shuffle,
-        seed=cfg.train.seed,
-        drop_last=cfg.train.drop_last,
-        max_steps_per_epoch=cfg.train.max_steps_per_epoch,
-        data_retries=cfg.train.data_retries,
-        fault_injector=fault_injector,
-    )
+    else:
+        dataset = build_dataset(
+            cfg.train.dataset,
+            _defaults={"size": cfg.train.dataset_size,
+                       "seed": cfg.train.seed},
+            **cfg.train.dataset_kwargs,
+        )
+        if cfg.train.eval_fraction > 0:
+            from distributed_training_tpu.data.datasets import (
+                train_eval_split,
+            )
+            dataset, eval_ds = train_eval_split(
+                dataset, cfg.train.eval_fraction, seed=cfg.train.seed,
+                multiple_of=cfg.train.batch_size * rt.data_shard_count)
+            eval_loader = ShardedDataLoader(
+                eval_ds, rt, batch_size=cfg.train.batch_size,
+                shuffle=False, seed=cfg.train.seed)
+        loader = ShardedDataLoader(
+            dataset, rt,
+            batch_size=cfg.train.batch_size,
+            shuffle=cfg.train.shuffle,
+            seed=cfg.train.seed,
+            drop_last=cfg.train.drop_last,
+            max_steps_per_epoch=cfg.train.max_steps_per_epoch,
+            data_retries=cfg.train.data_retries,
+            fault_injector=fault_injector,
+        )
     model_kwargs = dict(cfg.model.kwargs)
     # model-level dtype override wins over the training compute dtype
     model_dtype = model_kwargs.pop("dtype", cfg.train.dtype)
@@ -213,11 +245,35 @@ def main(argv: list[str] | None = None) -> int:
             # Emitted even on a fresh start when this IS a restart
             # incarnation (crash before the first checkpoint) — the
             # recovery table must not undercount those.
+            # Cursor evidence (docs/data.md): the restored pipeline
+            # position + realized mixture ride the resume event, so
+            # the summarizer's recovery table can PROVE exactly-once
+            # (samples replayed = step*global_batch - samples_consumed
+            # must be 0, and 0 the other way for skips).
+            cursor_info = {}
+            if hasattr(loader, "state_dict"):
+                data_state = loader.state_dict()
+                cursor_info = {
+                    "samples_consumed":
+                        data_state.get("samples_consumed"),
+                    "global_batch": loader.global_batch,
+                    "data_skips": data_state.get("skipped", 0),
+                }
+                # Mixture evidence only once something was consumed:
+                # a fresh-start restart incarnation (crash before the
+                # first save) has realized weights of all zeros, and
+                # the summarizer would render that as a large bogus
+                # mixture drift on a zero-consumption incident.
+                if data_state.get("samples_consumed"):
+                    for k in ("realized_mixture", "target_mixture"):
+                        if data_state.get(k):
+                            cursor_info[k] = data_state[k]
             tel.event("resume", step=trainer.global_step,
                       epoch=trainer.epochs_run,
                       restarts=restart_count,
                       world_size=rt.process_count,
-                      evicted_hosts=evicted_hosts)
+                      evicted_hosts=evicted_hosts,
+                      **cursor_info)
         try:
             if cfg.train.profile_dir:
                 from distributed_training_tpu.utils import profiler

@@ -366,7 +366,38 @@ class Trainer:
             restored = checkpointer.restore_latest(abstract_tree)
         if restored is not None:
             self.state, meta = restored
+            # Exactly-once resume (docs/data.md): the checkpoint meta
+            # carries the loader's serialized position; restoring it
+            # makes the interrupted epoch CONTINUE at its saved batch
+            # offset instead of replaying from the epoch start (the
+            # old behavior double-fed the optimizer every sample the
+            # interrupted epoch had already consumed). Checkpoints
+            # predating the state (or a state this loader cannot
+            # drive) fall back to the epoch-boundary resume.
             self.epochs_run = int(meta.get("epoch", -1)) + 1
+            data_state = meta.get("data")
+            restored_pos = False
+            if data_state and hasattr(self.loader, "load_state_dict"):
+                try:
+                    self.loader.load_state_dict(data_state)
+                    self.epochs_run = self.loader.resume_epoch
+                    restored_pos = True
+                except (ValueError, KeyError, TypeError) as e:
+                    logger.warning(
+                        "checkpointed loader state unusable (%s); "
+                        "resuming at the epoch boundary instead", e)
+            if not restored_pos and hasattr(self.loader, "seek_epoch"):
+                # Epoch-boundary fallback. A MID-EPOCH save whose
+                # offset is unusable must REPLAY its interrupted epoch
+                # from the start — skipping the remainder would
+                # silently drop up to an epoch of data; the replay is
+                # the lesser evil and the recovery table reports its
+                # replayed-sample count honestly (the cursor sits
+                # behind step * global_batch).
+                if isinstance(data_state, dict) and data_state.get(
+                        "mid_epoch"):
+                    self.epochs_run = max(0, self.epochs_run - 1)
+                self.loader.seek_epoch(self.epochs_run)
             logger.info("resumed from checkpoint: epoch=%d step=%d",
                         self.epochs_run, int(self.state["step"]))
         else:
@@ -632,103 +663,117 @@ class Trainer:
         div_every = self.cfg.train.divergence_check_every
         log_every = self.cfg.train.log_every
         it = iter(self.loader.epoch(epoch))
-        while True:
-            if self.watchdog is not None:
-                # Armed BEFORE the fetch: a wedged input pipeline (dead
-                # prefetch thread, stuck host data op) is exactly the
-                # silent-hang class the watchdog exists for, so the
-                # data wait must be inside the armed window. The first
-                # step gets a 10x allowance: compile time is expected
-                # to dwarf a steady-state step, and a watchdog tuned to
-                # step time must not fire on it.
-                self.watchdog.arm(
-                    step=self.global_step + 1, epoch=epoch,
-                    timeout_s=(self.watchdog.timeout_s * 10
-                               if self._steps_dispatched == 0
-                               else None))
-            # Host time blocked on the (prefetching) loader — the
-            # data_wait goodput bucket. Near-zero when prefetch keeps
-            # up; a hot data_wait is an input-pipeline limiter.
-            t_wait0 = time.perf_counter()
-            with self.telemetry.span("data_wait",
-                                     step=self.global_step + 1):
-                batch = next(it, None)
-            data_wait_s = time.perf_counter() - t_wait0
-            if batch is None:
+        try:
+            while True:
                 if self.watchdog is not None:
-                    self.watchdog.disarm()
-                break
-            t_step0 = time.perf_counter()
-            if self.faults is not None:
-                # slow_host fault: the injected degradation must land
-                # INSIDE the measured step region so the straggler
-                # detector attributes it exactly like a real slow
-                # host. A pure host-local sleep — no collective.
-                delay_s = self.faults.step_delay(self.global_step + 1)
-                if delay_s:
-                    time.sleep(delay_s)
-            metrics = self.train_step(batch)
-            if self.straggler.enabled:
-                self.straggler.record_step(
-                    time.perf_counter() - t_step0, data_wait_s)
-                # The exchange is a collective: its cadence (inside
-                # maybe_exchange) is a pure function of global_step so
-                # every host enters at the same loop point.
-                if (self.straggler.maybe_exchange(self.global_step)
-                        is not None and self.watchdog is not None):
-                    self.watchdog.set_context(
-                        self.straggler.watchdog_info())
-            if self.straggler.evict_request is not None:
-                # Coordinated eviction stop: the request derives from
-                # the all-gathered table at this exchange step, so
-                # EVERY host sees it here, at the same loop point —
-                # all break together, save, and exit cleanly; no host
-                # is left waiting in a collective during teardown.
-                if self.watchdog is not None:
-                    self.watchdog.disarm()
-                logger.warning(
-                    "stopping for elastic eviction of host %s "
-                    "(requested at step %s)",
-                    self.straggler.evict_request.get("host"),
-                    self.straggler.evict_request.get("step"))
-                self.metrics.record(self.global_step, metrics,
-                                    epoch=epoch)
-                losses.append(metrics["loss"])
-                break
-            if div_every and self.global_step % div_every == 0:
-                # Compiled cross-replica drift check (SURVEY.md §5.2's
-                # "diff the rank logs", formalized).
-                if (self.watchdog is not None
-                        and not self._div_check_compiled):
-                    # The first check jit-compiles the whole-params
-                    # fingerprint program inside the armed window —
-                    # give it the compile allowance too.
+                    # Armed BEFORE the fetch: a wedged input pipeline (dead
+                    # prefetch thread, stuck host data op) is exactly the
+                    # silent-hang class the watchdog exists for, so the
+                    # data wait must be inside the armed window. The first
+                    # step gets a 10x allowance: compile time is expected
+                    # to dwarf a steady-state step, and a watchdog tuned to
+                    # step time must not fire on it.
                     self.watchdog.arm(
-                        step=self.global_step, epoch=epoch,
-                        timeout_s=self.watchdog.timeout_s * 10)
-                self._div_check_compiled = True
-                report = self._check_divergence()
-                if report is not None:
-                    metrics = {**metrics, "replica_divergence":
-                               report["max_divergence"]}
-            self.metrics.record(self.global_step, metrics, epoch=epoch)
-            if self.hbm is not None:
-                self.hbm.maybe_sample(self.global_step)
-            if (self.ledger is not None and log_every > 0
-                    and self.global_step % log_every == 0):
-                self.telemetry.event(
-                    "goodput", scope="window", step=self.global_step,
-                    **self.ledger.window_report())
-            if self.watchdog is not None:
-                self.watchdog.disarm()
-            losses.append(metrics["loss"])
-            if self.faults is not None:
-                # After the step's bookkeeping, before the stop poll:
-                # a sigterm fault raised here is observed by
-                # _agreed_stop at the same loop point on every host.
-                self.faults.on_step(self.global_step)
-            if self._agreed_stop():
-                break
+                        step=self.global_step + 1, epoch=epoch,
+                        timeout_s=(self.watchdog.timeout_s * 10
+                                   if self._steps_dispatched == 0
+                                   else None))
+                # Host time blocked on the (prefetching) loader — the
+                # data_wait goodput bucket. Near-zero when prefetch keeps
+                # up; a hot data_wait is an input-pipeline limiter.
+                t_wait0 = time.perf_counter()
+                with self.telemetry.span("data_wait",
+                                         step=self.global_step + 1):
+                    batch = next(it, None)
+                data_wait_s = time.perf_counter() - t_wait0
+                if batch is None:
+                    if self.watchdog is not None:
+                        self.watchdog.disarm()
+                    break
+                t_step0 = time.perf_counter()
+                if self.faults is not None:
+                    # slow_host fault: the injected degradation must land
+                    # INSIDE the measured step region so the straggler
+                    # detector attributes it exactly like a real slow
+                    # host. A pure host-local sleep — no collective.
+                    delay_s = self.faults.step_delay(self.global_step + 1)
+                    if delay_s:
+                        time.sleep(delay_s)
+                metrics = self.train_step(batch)
+                if self.straggler.enabled:
+                    self.straggler.record_step(
+                        time.perf_counter() - t_step0, data_wait_s)
+                    # The exchange is a collective: its cadence (inside
+                    # maybe_exchange) is a pure function of global_step so
+                    # every host enters at the same loop point.
+                    if (self.straggler.maybe_exchange(self.global_step)
+                            is not None and self.watchdog is not None):
+                        self.watchdog.set_context(
+                            self.straggler.watchdog_info())
+                if self.straggler.evict_request is not None:
+                    # Coordinated eviction stop: the request derives from
+                    # the all-gathered table at this exchange step, so
+                    # EVERY host sees it here, at the same loop point —
+                    # all break together, save, and exit cleanly; no host
+                    # is left waiting in a collective during teardown.
+                    if self.watchdog is not None:
+                        self.watchdog.disarm()
+                    logger.warning(
+                        "stopping for elastic eviction of host %s "
+                        "(requested at step %s)",
+                        self.straggler.evict_request.get("host"),
+                        self.straggler.evict_request.get("step"))
+                    self.metrics.record(self.global_step, metrics,
+                                        epoch=epoch)
+                    losses.append(metrics["loss"])
+                    break
+                if div_every and self.global_step % div_every == 0:
+                    # Compiled cross-replica drift check (SURVEY.md §5.2's
+                    # "diff the rank logs", formalized).
+                    if (self.watchdog is not None
+                            and not self._div_check_compiled):
+                        # The first check jit-compiles the whole-params
+                        # fingerprint program inside the armed window —
+                        # give it the compile allowance too.
+                        self.watchdog.arm(
+                            step=self.global_step, epoch=epoch,
+                            timeout_s=self.watchdog.timeout_s * 10)
+                    self._div_check_compiled = True
+                    report = self._check_divergence()
+                    if report is not None:
+                        metrics = {**metrics, "replica_divergence":
+                                   report["max_divergence"]}
+                self.metrics.record(self.global_step, metrics, epoch=epoch)
+                if self.hbm is not None:
+                    self.hbm.maybe_sample(self.global_step)
+                if (self.ledger is not None and log_every > 0
+                        and self.global_step % log_every == 0):
+                    self.telemetry.event(
+                        "goodput", scope="window", step=self.global_step,
+                        **self.ledger.window_report())
+                if self.watchdog is not None:
+                    self.watchdog.disarm()
+                losses.append(metrics["loss"])
+                if self.faults is not None:
+                    # After the step's bookkeeping, before the stop poll:
+                    # a sigterm fault raised here is observed by
+                    # _agreed_stop at the same loop point on every host.
+                    self.faults.on_step(self.global_step)
+                if self._agreed_stop():
+                    break
+        finally:
+            # Every exit — natural end, preemption/eviction
+            # break, OR an exception unwinding (a crash fault,
+            # an XLA error) — must close the epoch iterator so
+            # the prefetch worker is signalled, drained and
+            # JOINED (never left blocked on a full queue
+            # holding dataset resources; data/loader.py), and
+            # the loader's consumed position stays exactly at
+            # the last batch the optimizer saw (what the
+            # checkpoint meta records).
+            close = getattr(it, "close", None)
+            if close is not None:
+                close()
         # One host sync per epoch, not per step — THE deliberate sync
         # point the DTT003 rule exists to protect (everything above
         # dispatches async; this drain happens once per epoch).
@@ -769,11 +814,22 @@ class Trainer:
                 # Collective save: every process participates (fixes the
                 # reference's rank-0-only FSDP save hang, SURVEY.md §8 B6).
                 # On preemption: save whatever we have, mid-epoch
-                # included (resume re-runs the interrupted epoch).
-                meta_epoch = epoch if not preempted else epoch - 1
+                # included. The loader's serialized position rides the
+                # meta (same sha256 manifest as the weights), so a
+                # resume continues the interrupted epoch at its saved
+                # cursor — no sample replayed, none skipped. Loaders
+                # without a position keep the legacy epoch-1 label
+                # (resume replays the interrupted epoch).
+                data_state = (self.loader.state_dict()
+                              if hasattr(self.loader, "state_dict")
+                              else None)
+                meta_epoch = (epoch if data_state is not None
+                              or not preempted else epoch - 1)
+                meta = {"epoch": meta_epoch, **self._arch_meta()}
+                if data_state is not None:
+                    meta["data"] = data_state
                 self.checkpointer.save(
-                    self.global_step, self.state,
-                    meta={"epoch": meta_epoch, **self._arch_meta()},
+                    self.global_step, self.state, meta=meta,
                     force=preempted)
                 if self.strategy.gather_on_save:
                     # Same epoch label as the sharded checkpoint: an

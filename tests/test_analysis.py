@@ -37,7 +37,8 @@ def _rules(tmp_path, src, name="x.py"):
 
 def test_registry_has_all_rules():
     assert {"DTT001", "DTT002", "DTT003", "DTT004", "DTT005",
-            "DTT006", "DTT007", "DTT008"} <= set(pitfalls.RULES)
+            "DTT006", "DTT007", "DTT008", "DTT009"} <= set(
+                pitfalls.RULES)
 
 
 def test_tests_directory_is_exempt(tmp_path):
@@ -296,6 +297,72 @@ def test_dtt008_derived_specs_and_scope_pass(tmp_path):
         "from jax.sharding import PartitionSpec as P\n"
         "a = P('fsdp')  # noqa: DTT008 — deliberate pin\n"),
         rel="distributed_training_tpu/train") if "DTT008" in p]
+
+
+# ---------------------------------------------------------------------------
+# DTT009 — unseeded RNG inside the data pipeline
+# ---------------------------------------------------------------------------
+
+
+def test_dtt009_flags_unseeded_rng_in_data(tmp_path):
+    problems = _rules_scoped(tmp_path, (
+        "import random\n"
+        "import numpy as np\n"
+        "def f(rows):\n"
+        "    rng = np.random.default_rng()\n"
+        "    rng2 = np.random.default_rng(seed=None)\n"
+        "    x = np.random.rand(4)\n"
+        "    y = np.random.permutation(10)\n"
+        "    random.shuffle(rows)\n"
+        "    z = random.random()\n"), rel="distributed_training_tpu/data")
+    assert len([p for p in problems if "DTT009" in p]) == 6, problems
+    assert any("default_rng() without an explicit seed" in p
+               for p in problems)
+    # Aliased import forms must not dodge the rule.
+    problems = _rules_scoped(tmp_path, (
+        "from numpy.random import default_rng as mk\n"
+        "import numpy.random as npr\n"
+        "a = mk()\n"
+        "b = npr.rand(4)\n"
+        "c = mk([1, 2])\n"), rel="distributed_training_tpu/data")
+    assert len([p for p in problems if "DTT009" in p]) == 2, problems
+
+
+def test_dtt009_seeded_and_scoped_forms_pass(tmp_path):
+    # Explicitly seeded constructors ARE the serializable-position
+    # discipline; generator methods and jax.random are out of scope.
+    assert not [p for p in _rules_scoped(tmp_path, (
+        "import numpy as np\n"
+        "import jax\n"
+        "def f(seed, epoch):\n"
+        "    rng = np.random.default_rng([seed, 0, epoch])\n"
+        "    g = np.random.Generator(np.random.Philox(key=seed))\n"
+        "    x = rng.permutation(10)\n"
+        "    k = jax.random.PRNGKey(0)\n"
+        "    y = jax.random.normal(k, (2,))\n"),
+        rel="distributed_training_tpu/data") if "DTT009" in p]
+    # Outside data/ the rule does not apply (models draw jax keys —
+    # DTT005's domain).
+    assert not [p for p in _rules_scoped(tmp_path, (
+        "import numpy as np\n"
+        "x = np.random.rand(4)\n"),
+        rel="distributed_training_tpu/models") if "DTT009" in p]
+    # noqa escape hatch.
+    assert not [p for p in _rules_scoped(tmp_path, (
+        "import numpy as np\n"
+        "x = np.random.rand(4)  # noqa: DTT009 — fixture\n"),
+        rel="distributed_training_tpu/data") if "DTT009" in p]
+
+
+def test_dtt009_zero_offenders_in_repo():
+    """The shipped data pipeline must satisfy its own rule: every RNG
+    under data/ is constructed from explicit integers."""
+    hits = []
+    root = os.path.join(REPO, "distributed_training_tpu", "data")
+    for path in pitfalls.iter_py_files(root):
+        hits += [p for p in pitfalls.check_file_rules(path, repo=REPO)
+                 if "DTT009" in p]
+    assert hits == [], hits
 
 
 # ---------------------------------------------------------------------------

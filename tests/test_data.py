@@ -100,6 +100,22 @@ def test_image_dataset():
     assert b["x"].shape == (2, 32, 32, 3) and b["y"].shape == (2,)
 
 
+def test_doc_dataset_ragged_and_deterministic():
+    from distributed_training_tpu.data.datasets import SyntheticDocDataset
+    a = SyntheticDocDataset(size=16, min_len=3, max_len=9,
+                            vocab_size=50, seed=4)
+    b = SyntheticDocDataset(size=16, min_len=3, max_len=9,
+                            vocab_size=50, seed=4)
+    lens = {len(a.doc(i)) for i in range(16)}
+    assert lens <= set(range(3, 10)) and len(lens) > 1
+    np.testing.assert_array_equal(a.doc(5), b.doc(5))
+    # map-style probe contract: zero-padded to the corpus max length
+    probe = a.batch(np.array([0, 5]))
+    assert probe["tokens"].shape == (2, 9)
+    np.testing.assert_array_equal(
+        probe["tokens"][1][:len(a.doc(5))], a.doc(5))
+
+
 def test_memmap_tokens(tmp_path):
     path = str(tmp_path / "tokens.bin")
     np.arange(1000, dtype=np.uint16).tofile(path)
@@ -179,3 +195,184 @@ def test_prefetch_propagates_errors(cpu8):
     assert next(it) == 1
     with pytest.raises(RuntimeError, match="boom"):
         list(it)
+
+
+def _prefetch_threads():
+    import threading
+    return [t for t in threading.enumerate()
+            if t.name == "data-prefetch" and t.is_alive()]
+
+
+def test_half_consumed_epoch_leaves_no_producer_thread(cpu8):
+    """A consumer that stops early (preemption, epoch cap, crash) must
+    not strand the prefetch worker blocked on a full queue: closing
+    the epoch iterator signals stop, drains, and JOINS the thread."""
+    ds = SyntheticRegressionDataset(size=512, seed=0)
+    dl = ShardedDataLoader(ds, cpu8, batch_size=4, prefetch_depth=2)
+    it = iter(dl.epoch(0))
+    next(it)  # worker alive, queue filling
+    assert _prefetch_threads()
+    it.close()
+    assert not _prefetch_threads(), \
+        "prefetch worker leaked after early consumer exit"
+
+
+def test_prefetch_worker_joined_on_gc(cpu8):
+    """Dropping the iterator (the crash-unwind shape) must also stop
+    the worker via the generator finalizer."""
+    ds = SyntheticRegressionDataset(size=512, seed=0)
+    dl = ShardedDataLoader(ds, cpu8, batch_size=4, prefetch_depth=2)
+    it = iter(dl.epoch(0))
+    next(it)
+    del it
+    import gc
+    gc.collect()
+    assert not _prefetch_threads()
+
+
+def test_assemble_probes_row0_once(cpu8):
+    """The column spec (names/shapes/dtypes) is learned from ONE probe
+    and cached — re-probing row 0 per step doubles IO on a
+    remote/memmap source."""
+
+    class CountingDataset:
+        def __init__(self, base):
+            self.base = base
+            self.single_row_calls = 0
+
+        def __len__(self):
+            return len(self.base)
+
+        def batch(self, idx):
+            if len(idx) == 1:
+                self.single_row_calls += 1
+            return self.base.batch(idx)
+
+    ds = CountingDataset(SyntheticRegressionDataset(size=64, seed=0))
+    dl = ShardedDataLoader(ds, cpu8, batch_size=2, shuffle=False,
+                           prefetch_depth=0)
+    assert dl.steps_per_epoch == 4
+    list(dl.epoch(0))
+    list(dl.epoch(1))
+    assert ds.single_row_calls == 1
+
+
+# --- checkpointable position (exactly-once resume; data/stream.py has
+# --- the multi-source properties) ------------------------------------------
+
+
+def test_loader_state_tracks_consumption(cpu8):
+    ds = SyntheticRegressionDataset(size=64, seed=0)
+    dl = ShardedDataLoader(ds, cpu8, batch_size=2, seed=3)
+    assert dl.state_dict()["samples_consumed"] == 0
+    it = iter(dl.epoch(0))
+    next(it), next(it)
+    it.close()
+    st = dl.state_dict()
+    assert (st["epoch"], st["step_in_epoch"]) == (0, 2)
+    assert st["samples_consumed"] == 2 * dl.global_batch
+    # A fully consumed epoch normalizes to the next epoch's boundary.
+    list(dl.epoch(1))
+    st = dl.state_dict()
+    assert (st["epoch"], st["step_in_epoch"]) == (2, 0)
+
+
+def test_loader_mid_epoch_resume_is_exactly_once(cpu8):
+    """save → restore in a NEW loader → continue yields exactly the
+    uninterrupted epoch's remaining batches (same rows, same order)."""
+    import json
+    ds = SyntheticRegressionDataset(size=64, seed=0)
+    ref = ShardedDataLoader(ds, cpu8, batch_size=2, seed=3)
+    want = [np.asarray(b["x"]) for b in ref.epoch(1)]
+
+    a = ShardedDataLoader(ds, cpu8, batch_size=2, seed=3)
+    it = iter(a.epoch(1))
+    got = [np.asarray(next(it)["x"])]
+    state = json.loads(json.dumps(a.state_dict()))
+    it.close()
+
+    b = ShardedDataLoader(ds, cpu8, batch_size=2, seed=3)
+    b.load_state_dict(state)
+    assert b.resume_epoch == 1
+    got.extend(np.asarray(x["x"]) for x in b.epoch(1))
+    assert len(got) == len(want)
+    for x, y in zip(got, want):
+        np.testing.assert_array_equal(x, y)
+
+
+def test_loader_state_geometry_change_mid_epoch_raises(cpu8):
+    """A changed steps_per_epoch makes a mid-epoch offset meaningless:
+    raising routes the trainer to its replay-the-epoch fallback
+    (silently skipping the remainder would drop data). Epoch-boundary
+    positions survive geometry changes."""
+    ds = SyntheticRegressionDataset(size=64, seed=0)
+    a = ShardedDataLoader(ds, cpu8, batch_size=2, seed=3)
+    it = iter(a.epoch(0))
+    next(it)
+    it.close()
+    mid = a.state_dict()
+    list(a.epoch(1))  # brings position to the epoch-2 boundary
+    boundary = a.state_dict()
+    b = ShardedDataLoader(ds, cpu8, batch_size=4, seed=3)  # spe 4 -> 2
+    with pytest.raises(ValueError, match="steps_per_epoch"):
+        b.load_state_dict(mid)
+    b.load_state_dict(boundary)
+    assert b.resume_epoch == 2
+
+
+def test_loader_state_rejects_shuffle_change(cpu8):
+    ds = SyntheticRegressionDataset(size=64, seed=0)
+    a = ShardedDataLoader(ds, cpu8, batch_size=2, shuffle=True, seed=3)
+    it = iter(a.epoch(0))
+    next(it)
+    it.close()
+    state = a.state_dict()
+    b = ShardedDataLoader(ds, cpu8, batch_size=2, shuffle=False, seed=3)
+    with pytest.raises(ValueError, match="shuffle"):
+        b.load_state_dict(state)
+
+
+def test_loader_state_rejects_foreign_impl(cpu8):
+    ds = SyntheticRegressionDataset(size=64, seed=0)
+    dl = ShardedDataLoader(ds, cpu8, batch_size=2)
+    with pytest.raises(ValueError, match="unsupported"):
+        dl.load_state_dict({"schema": 1, "impl": "stream"})
+
+
+def test_loader_state_rejects_world_change_mid_epoch(cpu8):
+    """The strided per-epoch deal is a function of num_shards: the
+    same global batch over a different world assigns different rows
+    to each step, so a mid-epoch offset is not transferable across an
+    elastic resize (epoch boundaries are)."""
+    from distributed_training_tpu.runtime import fake_cpu_runtime
+    ds = SyntheticRegressionDataset(size=64, seed=0)
+    a = ShardedDataLoader(ds, cpu8, batch_size=2, seed=3)
+    it = iter(a.epoch(0))
+    next(it)
+    it.close()
+    mid = a.state_dict()
+    list(a.epoch(1))
+    boundary = a.state_dict()
+    # world 8 -> 4 at the same global batch: spe coincides, rows don't.
+    b = ShardedDataLoader(ds, fake_cpu_runtime(4), batch_size=4, seed=3)
+    assert b.steps_per_epoch == a.steps_per_epoch
+    with pytest.raises(ValueError, match="num_shards|batch_size"):
+        b.load_state_dict(mid)
+    b.load_state_dict(boundary)
+    assert b.resume_epoch == 2
+
+
+def test_loader_state_rejects_seed_change(cpu8):
+    """A changed train.seed reshuffles every epoch: resuming at the
+    saved OFFSET of a different permutation would silently skip and
+    replay rows while the cursor math still claims exactly-once."""
+    ds = SyntheticRegressionDataset(size=64, seed=0)
+    a = ShardedDataLoader(ds, cpu8, batch_size=2, seed=3)
+    it = iter(a.epoch(0))
+    next(it)
+    it.close()
+    state = a.state_dict()
+    assert state["mid_epoch"] is True
+    b = ShardedDataLoader(ds, cpu8, batch_size=2, seed=4)
+    with pytest.raises(ValueError, match="seed"):
+        b.load_state_dict(state)

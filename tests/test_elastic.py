@@ -659,7 +659,10 @@ def _elastic_event_stream():
         {"kind": "clock_sync", "t": 120.5, "t_sync": 120.5,
          "process_index": 0, "process_count": 3},
         {"kind": "resume", "t": 121.0, "step": 8, "restarts": 1,
-         "world_size": 3, "evicted_hosts": [2]},
+         "world_size": 3, "evicted_hosts": [2],
+         "samples_consumed": 96, "global_batch": 12,
+         "realized_mixture": {"a": 0.5, "b": 0.5},
+         "target_mixture": {"a": 0.5, "b": 0.5}},
         {"kind": "span", "t": 125.0, "name": "step", "step": 12},
     ]
 
@@ -727,8 +730,14 @@ def test_multihost_summary_renders_elastic_without_schema_bump(
     rec = summary["recovery"]
     assert rec["restarts"] == 1
     assert rec["incidents"][0]["new_world"] == 3
+    # Exactly-once columns (resume-event cursor fields) flow through
+    # the shared _recovery into the aggregate — additive, schema 1.
+    assert rec["incidents"][0]["samples_replayed"] == 0
+    assert rec["incidents"][0]["samples_skipped"] == 0
+    assert rec["incidents"][0]["mixture_drift"] == 0.0
     text = aggregate.render_multihost(summary)
     assert "world 4 -> 3" in text
+    assert "0 sample(s) replayed / 0 skipped" in text
     # The CLI renders it end to end.
     from distributed_training_tpu.telemetry.summarize import main
     assert main([str(run_dir)]) == 0

@@ -769,6 +769,74 @@ def test_recovery_counts_fresh_start_restart():
     assert rec2["restarts"] == 1
 
 
+def test_recovery_exactly_once_columns_from_cursor():
+    """Resume events carrying the loader cursor (docs/data.md) add
+    samples-replayed / samples-skipped / mixture-drift columns to the
+    incident — additive keys; cursor-less resume events keep the old
+    incident shape."""
+    from distributed_training_tpu.telemetry.summarize import (
+        _recovery, render_recovery_lines)
+
+    def run(resume_extra):
+        return _recovery([
+            {"kind": "run_start", "t": 100.0, "step": 0},
+            {"kind": "span", "t": 105.0, "name": "step", "step": 12},
+            {"kind": "run_start", "t": 120.0, "step": 10},
+            {"kind": "resume", "t": 121.0, "step": 10, "restarts": 1,
+             **resume_extra},
+        ])["incidents"][0]
+
+    # Exactly-once: cursor == step * global_batch -> 0 / 0.
+    inc = run({"samples_consumed": 80, "global_batch": 8,
+               "realized_mixture": {"a": 0.67, "b": 0.33},
+               "target_mixture": {"a": 0.666667, "b": 0.333333}})
+    assert inc["samples_replayed"] == 0
+    assert inc["samples_skipped"] == 0
+    assert inc["mixture_drift"] == pytest.approx(0.003333, abs=1e-6)
+
+    # The legacy epoch-replay resume shows its replays honestly.
+    inc = run({"samples_consumed": 48, "global_batch": 8})
+    assert inc["samples_replayed"] == 32
+    assert inc["samples_skipped"] == 0
+    assert "mixture_drift" not in inc
+
+    # A cursor ahead of the optimizer step is a skip.
+    inc = run({"samples_consumed": 96, "global_batch": 8})
+    assert inc["samples_skipped"] == 16
+
+    # No cursor fields -> pre-stream incident shape, unchanged.
+    inc = run({})
+    assert "samples_replayed" not in inc
+
+    lines = "\n".join(render_recovery_lines(_recovery([
+        {"kind": "run_start", "t": 100.0, "step": 0},
+        {"kind": "span", "t": 105.0, "name": "step", "step": 12},
+        {"kind": "run_start", "t": 120.0, "step": 10},
+        {"kind": "resume", "t": 121.0, "step": 10, "restarts": 1,
+         "samples_consumed": 80, "global_batch": 8},
+    ])))
+    assert "0 sample(s) replayed / 0 skipped" in lines
+
+
+def test_recovery_counts_recorded_data_skips():
+    """Deliberate skip-and-record corrupt-sample skips surface in the
+    recovery section (with their (source, sample_id) evidence) even
+    when the run never restarted."""
+    from distributed_training_tpu.telemetry.summarize import (
+        _recovery, render_recovery_lines)
+    rec = _recovery([
+        {"kind": "run_start", "t": 100.0, "step": 0},
+        {"kind": "data_skip", "t": 101.0, "source": "wiki",
+         "sample_id": 7, "step": 3},
+    ])
+    assert rec is not None and rec["restarts"] == 0
+    assert rec["data_skips"] == [
+        {"source": "wiki", "sample_id": 7, "step": 3}]
+    text = "\n".join(render_recovery_lines(rec))
+    assert "1 corrupt sample(s) skipped" in text
+    assert "wiki[7]" in text
+
+
 # -- e2e: crash → supervised restart → resume → identical result ----------
 
 
