@@ -2,81 +2,59 @@
 """Summarize a jax.profiler trace: top self-time ops per device.
 
 Closes the attribution loop for MFU work without a TensorBoard UI:
-``profile_step.py --trace DIR`` writes an ``.xplane.pb``; this reads it
-back through the installed XProf plugin and prints where the step time
-actually goes (op name, self time, fraction) — so tuning decisions cite
-measured op time, not vibes.
+``profile_step.py --trace DIR`` writes an ``.xplane.pb``; this reads
+it back and prints where the step time actually goes — so tuning
+decisions cite measured op time, not vibes.
+
+Thin wrapper over ``telemetry/xplane.py`` (the one xplane parsing
+surface — the trainer's in-run attribution reads traces through the
+same module, so the offline tool and the runtime path cannot drift):
+
+- the default per-op self-time table needs the standalone ``xprof``
+  package (the tensorboard_plugin_profile in this image is
+  protobuf-incompatible); a missing/broken install prints the remedy
+  and exits nonzero instead of a raw ImportError traceback;
+- ``--attribution`` is dependency-free: the stdlib XSpace reader
+  decomposes the captured timeline into compute / collective /
+  host+data + overlap % — the same report the trainer emits as an
+  ``attribution`` event under ``train.profile_at``.
 
     python benchmarks/profile_step.py --batch 32 --trace /tmp/trace
-    python benchmarks/analyze_trace.py /tmp/trace --top 25
+    python benchmarks/analyze_trace.py /tmp/trace/<session> --top 25
+    python benchmarks/analyze_trace.py /tmp/trace/<session> --attribution
 """
 
 from __future__ import annotations
 
 import argparse
-import glob
 import json
 import os
 import sys
 
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
 
-def find_xplane(trace_dir: str) -> str:
-    hits = sorted(glob.glob(os.path.join(
-        trace_dir, "**", "*.xplane.pb"), recursive=True))
-    if not hits:
-        raise FileNotFoundError(
-            f"no .xplane.pb under {trace_dir} — pass the dir given to "
-            "jax.profiler.trace / profile_step.py --trace")
-    return hits[-1]  # latest session
+from distributed_training_tpu.telemetry import xplane  # noqa: E402
+from distributed_training_tpu.telemetry.xplane import (  # noqa: E402,F401 — re-exported for callers of the old module layout
+    find_xplane, op_category, op_rows)
 
 
-def op_rows(xplane_path: str) -> list[dict]:
-    """Per-op self-time rows from the framework_op_stats tool (via the
-    standalone ``xprof`` package — the tensorboard_plugin_profile in
-    this image is protobuf-incompatible)."""
-    from xprof.convert import raw_to_tool_data
-
-    data, _ = raw_to_tool_data.xspace_to_tool_data(
-        [xplane_path], "framework_op_stats", {"tqx": "out:json;"})
-    tables = json.loads(data)
-    # First table = the op breakdown (subsequent ones are summaries).
-    table = tables[0] if isinstance(tables, list) else tables
-    cols = [c["label"] for c in table["cols"]]
-    rows = []
-    for r in table["rows"]:
-        # gviz represents empty cells as nulls in the 'c' array.
-        vals = [(c or {}).get("v") for c in r["c"]]
-        rows.append(dict(zip(cols, vals)))
-    return rows
-
-
-def op_category(row: dict) -> str:
-    """Subsystem label for one op row. Prefers the tool's own Category
-    column (lowercased so it can't split one subsystem across two
-    rollup lines against fallback labels); the op-name patterns are
-    the fallback classifier. Collective patterns come FIRST — they
-    embed 'gather'/'scatter' as substrings, and communication being
-    misfiled under memory ops would invert the matmul-vs-comms
-    conclusion this rollup exists to draw."""
-    cat = row.get("Category")
-    if cat:
-        return str(cat).lower()
-    name = str(row.get("Operation Name") or row.get("Operation")
-               or "").lower()
-    for pat, label in (("all-to-all", "collective"),
-                       ("all-reduce", "collective"),
-                       ("all-gather", "collective"),
-                       ("reduce-scatter", "collective"),
-                       ("collective", "collective"),
-                       ("permute", "collective"),
-                       ("dot", "matmul"), ("conv", "conv"),
-                       ("fusion", "fusion"), ("copy", "copy"),
-                       ("transpose", "transpose"),
-                       ("gather", "gather"), ("scatter", "scatter"),
-                       ("custom-call", "custom-call")):
-        if pat in name:
-            return label
-    return "other"
+def print_attribution(path: str) -> int:
+    """Dependency-free compute/collective/host decomposition — the
+    same xplane.py arithmetic the trainer's ``attribution`` event
+    uses, offline."""
+    rep = xplane.attribution_of_planes(xplane.load_xspace(path))
+    print(f"# {path}", file=sys.stderr)
+    print(f"window {rep['window_s'] * 1e3:10.3f} ms "
+          f"({rep['source']} timeline, {rep['events']} events on "
+          f"{rep['lanes']} lane(s))")
+    for key, label in (("compute_frac", "compute"),
+                       ("collective_frac", "collective (exposed)"),
+                       ("host_frac", "host+data")):
+        print(f"  {label:20s} {rep[key]:7.2%}")
+    print(f"  {'overlap':20s} {rep['overlap_frac']:7.2%} of "
+          "collective time hidden under compute")
+    return 0
 
 
 def main() -> int:
@@ -85,11 +63,20 @@ def main() -> int:
     ap.add_argument("--top", type=int, default=20)
     ap.add_argument("--json", action="store_true",
                     help="emit raw rows as JSON lines")
+    ap.add_argument("--attribution", action="store_true",
+                    help="compute/collective/host + overlap "
+                         "decomposition (no xprof needed)")
     args = ap.parse_args()
 
-    path = find_xplane(args.trace_dir)
-    print(f"# {path}", file=sys.stderr)
-    rows = op_rows(path)
+    try:
+        path = xplane.find_xplane(args.trace_dir)
+        if args.attribution:
+            return print_attribution(path)
+        print(f"# {path}", file=sys.stderr)
+        rows = op_rows(path)
+    except xplane.XplaneError as e:
+        print(f"analyze_trace: {e}", file=sys.stderr)
+        return 2
 
     # Device-side ops ranked by total self time; a CPU-platform trace
     # records everything as Host — fall back so the tool works on the

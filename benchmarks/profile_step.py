@@ -119,11 +119,27 @@ def main(argv=None) -> int:
         # Drop the params alias and the side executables so the traced
         # steps run with donation live (see the step-timing comment).
         del params, fwd, loss, grad
-        with jax.profiler.trace(args.trace):
+        # One UNIQUE subdir per invocation: jax writes each session
+        # under a timestamped plugins/profile/<ts>/ inside the dir,
+        # and analyze_trace picks the LATEST .xplane.pb under
+        # whatever dir it is handed — so back-to-back profiles into
+        # one shared dir silently analyzed the previous session's
+        # trace whenever a capture failed. A per-run subdir makes the
+        # pairing explicit, and the printed command targets exactly
+        # this session.
+        trace_dir = os.path.join(
+            args.trace,
+            time.strftime("session_%Y%m%dT%H%M%S")
+            + f"_pid{os.getpid()}")
+        with jax.profiler.trace(trace_dir):
             for _ in range(3):
                 trainer.train_step(batch)
             jax.block_until_ready(trainer.state["params"])
-        print(f"trace written to {args.trace}")
+        print(f"trace written to {trace_dir}")
+        print("analyze it:\n"
+              f"  python benchmarks/analyze_trace.py {trace_dir}\n"
+              f"  python benchmarks/analyze_trace.py {trace_dir} "
+              "--attribution")
     return 0
 
 

@@ -61,6 +61,13 @@ def main(argv=None) -> int:
     ap.add_argument("--baseline", default=None, metavar="PATH",
                     help="baseline file (default: the committed "
                          "analysis/spmd_baseline.json)")
+    ap.add_argument("--overlap-baseline", default=None,
+                    metavar="PATH",
+                    help="overlap-ratchet baseline file (default: "
+                         "the committed analysis/"
+                         "OVERLAP_baseline.json); like --baseline, "
+                         "a custom path keeps --write-baseline off "
+                         "the committed file")
     ap.add_argument("--write-baseline", action="store_true",
                     help="freeze current audit findings as the new "
                          "baseline")
@@ -73,6 +80,7 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     rc = 0
+    write_failed = False
     if not args.no_rules:
         problems = run_rules()
         for p in problems:
@@ -96,11 +104,29 @@ def main(argv=None) -> int:
             names,
             min_replicated_bytes=int(
                 args.min_replicated_mib * 2**20))
+        from distributed_training_tpu.analysis import targets
+        overlap_pins = {
+            t.name: t.min_overlap for t in targets.TARGETS.values()
+            if t.min_overlap is not None}
         if args.write_baseline:
             path = baseline.write(audit.all_findings(doc),
                                   path=args.baseline)
             print(f"[analysis] baseline written: {path} "
                   f"({doc['totals']['findings']} finding(s))")
+            try:
+                opath = baseline.write_overlap(
+                    doc, path=args.overlap_baseline,
+                    min_overlap=overlap_pins)
+                print(f"[analysis] overlap baseline written: {opath}")
+            except ValueError as e:
+                # Pin outranks --write-baseline: a destroyed schedule
+                # cannot become the new floor. A refused write is a
+                # failed REQUESTED action — nonzero even without
+                # --check (unlike report-only findings), or a regen
+                # script would proceed on a stale floor.
+                print(f"[analysis] OVERLAP baseline NOT written: {e}")
+                rc = 1
+                write_failed = True
         cmp = baseline.compare(audit.all_findings(doc),
                                baseline.load(args.baseline),
                                targets=names)
@@ -127,9 +153,19 @@ def main(argv=None) -> int:
             # class returning is a regression even when --write-
             # baseline would happily freeze it.
             rc = 1
+        overlap_problems = baseline.compare_overlap(
+            doc, baseline.load_overlap(args.overlap_baseline),
+            min_overlap=overlap_pins)
+        for p in overlap_problems:
+            print(f"[analysis] OVERLAP regression: {p}")
+        if overlap_problems:
+            # The overlap ratchet: a schedule change that stops
+            # hiding comms under compute on a gated target is a perf
+            # regression tier-1 catches without a chip.
+            rc = 1
 
     if not args.check:
-        return 0
+        return 1 if write_failed else 0
     return rc
 
 

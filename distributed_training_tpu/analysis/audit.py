@@ -145,6 +145,7 @@ def audit_target(target, min_replicated_bytes: int = 1 << 20) -> dict:
     collective summary + reshard-warning count)."""
     import jax.numpy as jnp
 
+    from distributed_training_tpu.telemetry import attribution
     from distributed_training_tpu.telemetry import collectives
 
     trainer, rt, batch = build_abstract_trainer(
@@ -179,6 +180,12 @@ def audit_target(target, min_replicated_bytes: int = 1 << 20) -> dict:
         "findings": findings,
         "findings_by_code": by_code,
         "collectives": collectives.summary_of_event(coll),
+        # Static comms/compute overlap of the compiled schedule
+        # (telemetry/attribution.py), from the SAME compile as the
+        # findings above — ratcheted against OVERLAP_baseline.json by
+        # the gate (__main__.py). Additive key; SCHEMA stays 1.
+        "overlap": attribution.overlap_summary(
+            attribution.hlo_overlap_report(text)),
     }
 
 
@@ -250,6 +257,13 @@ def render_report(audit_doc: dict, cmp: dict | None = None
                      f"{r['strategy']} mesh={mesh}")
         for line in collectives.render_lines(r["collectives"]):
             lines.append("   " + line)
+        ov = r.get("overlap") or {}
+        if ov.get("scored"):
+            lines.append(
+                f"   overlap: {ov['overlap_score']:.2f} of "
+                f"{ov['scored']} collective(s) scheduled with "
+                f"independent compute in their latency window "
+                f"(mean {ov['mean_compute_between']:.1f} op(s))")
         if not r["findings"]:
             lines.append("   findings: none")
         for f in r["findings"]:

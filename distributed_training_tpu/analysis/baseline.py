@@ -23,6 +23,18 @@ SCHEMA = 1
 DEFAULT_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                             "spmd_baseline.json")
 
+# Static comms/compute overlap ratchet (telemetry/attribution.py
+# scores, per audit target). Unlike the findings ratchet — which lets
+# KNOWN debt ride — this one pins a FLOOR: the committed score is the
+# worst the gate accepts, improvements raise it at the next
+# --write-baseline, regressions fail. A target's ``min_overlap`` pin
+# outranks the baseline AND --write-baseline (the pin_zero rule):
+# a regressed score below the pin cannot be laundered into a new
+# baseline.
+OVERLAP_SCHEMA = 1
+OVERLAP_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "OVERLAP_baseline.json")
+
 
 def load(path: str | None = None) -> dict:
     """The baseline doc ({"schema": 1, "fingerprints": [...]});
@@ -81,6 +93,104 @@ def write(findings: list[dict], path: str | None = None,
         "messages": {
             f["fingerprint"]: f["message"]
             for f in sorted(findings, key=lambda x: x["fingerprint"])},
+    }
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+        f.write("\n")
+    os.replace(tmp, path)
+    return path
+
+
+# ---------------------------------------------------------------------------
+# overlap ratchet
+# ---------------------------------------------------------------------------
+
+
+def load_overlap(path: str | None = None) -> dict:
+    """The overlap baseline ({"schema": 1, "targets": {name:
+    {"overlap_score": x, "scored": n}}}); missing file = empty —
+    nothing is gated until a baseline is written."""
+    path = path or OVERLAP_PATH
+    if not os.path.exists(path):
+        return {"schema": OVERLAP_SCHEMA, "targets": {}}
+    with open(path, encoding="utf-8") as f:
+        doc = json.load(f)
+    if doc.get("schema") != OVERLAP_SCHEMA:
+        raise ValueError(
+            f"overlap baseline {path} has schema "
+            f"{doc.get('schema')!r}, expected {OVERLAP_SCHEMA} — "
+            "regenerate with --write-baseline")
+    return doc
+
+
+def _overlap_rows(audit_doc: dict) -> dict[str, dict]:
+    return {r["target"]: (r.get("overlap") or {})
+            for r in audit_doc.get("targets", [])}
+
+
+def compare_overlap(audit_doc: dict, baseline_doc: dict,
+                    min_overlap: dict[str, float] | None = None
+                    ) -> list[str]:
+    """Ratchet check: one problem string per regression. A target's
+    current score must be >= its baselined score, and >= its
+    ``min_overlap`` pin regardless of what the baseline says. A
+    target whose collectives all vanished from scoring (score None)
+    against a numeric baseline is a regression too — the overlap
+    evidence disappeared, which is exactly what a schedule-destroying
+    change looks like."""
+    min_overlap = min_overlap or {}
+    base = baseline_doc.get("targets", {})
+    problems: list[str] = []
+    for name, ov in _overlap_rows(audit_doc).items():
+        cur = ov.get("overlap_score")
+        pin = min_overlap.get(name)
+        if pin is not None and (cur is None or cur < pin):
+            problems.append(
+                f"{name}: overlap score "
+                f"{'none' if cur is None else f'{cur:.3f}'} is below "
+                f"this target's min_overlap pin {pin:.3f} (pins "
+                "outrank the baseline — a destroyed schedule cannot "
+                "be baselined in)")
+            continue
+        b = base.get(name, {}).get("overlap_score")
+        if b is None:
+            continue  # not gated until baselined
+        if cur is None or cur < b:
+            problems.append(
+                f"{name}: overlap score "
+                f"{'none' if cur is None else f'{cur:.3f}'} regressed "
+                f"below the OVERLAP_baseline.json floor {b:.3f} "
+                f"({ov.get('scored', 0)} collective(s) scored)")
+    return problems
+
+
+def write_overlap(audit_doc: dict, path: str | None = None,
+                  min_overlap: dict[str, float] | None = None) -> str:
+    """Freeze current per-target overlap scores as the new floor.
+    Refuses to freeze a score below a target's ``min_overlap`` pin —
+    --write-baseline must not launder a destroyed schedule."""
+    min_overlap = min_overlap or {}
+    targets: dict[str, dict] = {}
+    for name, ov in _overlap_rows(audit_doc).items():
+        cur = ov.get("overlap_score")
+        pin = min_overlap.get(name)
+        if pin is not None and (cur is None or cur < pin):
+            raise ValueError(
+                f"refusing to baseline {name} at overlap score "
+                f"{'none' if cur is None else f'{cur:.3f}'}: below "
+                f"its min_overlap pin {pin:.3f}")
+        targets[name] = {"overlap_score": cur,
+                         "scored": ov.get("scored", 0)}
+    path = path or OVERLAP_PATH
+    doc = {
+        "schema": OVERLAP_SCHEMA,
+        "note": "Per-target static comms/compute overlap floors "
+                "(telemetry/attribution.py hlo_overlap_report). The "
+                "gate fails when a target's score drops below its "
+                "floor. Regenerate: python -m "
+                "distributed_training_tpu.analysis --write-baseline",
+        "targets": targets,
     }
     tmp = path + ".tmp"
     with open(tmp, "w", encoding="utf-8") as f:

@@ -56,6 +56,12 @@ class AuditTarget:
     # --check even if its fingerprints are baselined — the mechanism
     # that keeps a FIXED cliff fixed.
     pin_zero: tuple = ()
+    # Static comms/compute overlap floor (telemetry/attribution.py
+    # score): a compiled schedule scoring below this fails the gate
+    # even if OVERLAP_baseline.json was rewritten lower — the
+    # pin-outranks-baseline rule, overlap edition. None = ratchet
+    # against the committed baseline only.
+    min_overlap: float | None = None
     note: str = ""
 
 
@@ -155,6 +161,11 @@ def _register_planned_target() -> None:
             dtype=mk.get("dtype", "float32"),
             optimizer=plan["inputs"]["optimizer"]),
         pin_zero=("SPMD001",),
+        # Floor under the measured 0.32 (CPU-partitioner schedule,
+        # 63 collectives scored): a plan/model change that destroys
+        # overlap scheduling fails even through --write-baseline.
+        # The ratchet (OVERLAP_baseline.json) holds the exact score.
+        min_overlap=0.25,
         note="The committed auto-parallelism plan (conf/plans/) "
              "compiled through the trainer's PlannedStrategy path — "
              "the configuration benchmarks/bench_multichip.py "

@@ -188,6 +188,27 @@ class TrainConfig:
     # slower than a few seconds.
     stop_poll_every: int = 8
     profile_dir: str = ""         # non-empty → jax.profiler traces here
+    # In-run profiler capture + step-time attribution (telemetry/
+    # attribution.py): comma-separated global steps, e.g. "20" or
+    # "20,500". At each step the COORDINATOR captures a jax.profiler
+    # trace of profile_steps steps into <run_dir>/profiles/ and
+    # immediately emits an `attribution` event (compute / collective /
+    # host+data fractions + overlap %). One-shot across supervisor
+    # restarts. An already-running job is profiled on demand by
+    # dropping a file named `profile_now` in the run dir. Empty and no
+    # trigger file → off. Mutually exclusive in spirit with
+    # profile_dir (a whole-run trace); if both are live the capture
+    # declines to start.
+    profile_at: str = ""
+    profile_steps: int = 2
+    # Live metrics endpoint (telemetry/metrics_server.py): when > 0
+    # the coordinator serves Prometheus text exposition on this port —
+    # GET /metrics (step time, tokens/s, MFU, goodput, data_wait,
+    # straggler verdicts, overlap %, world size/incarnation) and GET
+    # /healthz (503 once the step loop has stalled past the watchdog
+    # threshold). Fed from the same Telemetry sink as events.jsonl —
+    # one metrics source of truth. 0 disables.
+    metrics_port: int = 0
     # Deterministic fault injection (resilience/faults.py): e.g.
     # "crash@40,sigterm@80,corrupt_ckpt@120,data_stall@60:500ms".
     # Every trigger is a pure function of the global step (multi-host

@@ -363,12 +363,21 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--elastic-no-grow", action="store_true",
                    help="stay at the shrunken size for the rest of "
                         "the run")
+    p.add_argument("--metrics-port", type=int, default=0,
+                   metavar="PORT",
+                   help="serve the coordinator's live Prometheus "
+                        "endpoint (/metrics, /healthz) on this port "
+                        "— appends train.metrics_port=PORT to the "
+                        "train command (coordinator-gated there; see "
+                        "docs/observability.md)")
     p.add_argument("cmd", nargs=argparse.REMAINDER,
                    help="-- followed by the python argv to run")
     args = p.parse_args(argv)
     cmd = [c for c in args.cmd if c != "--"]
     if not cmd:
         cmd = ["-m", "distributed_training_tpu.train"]
+    if args.metrics_port:
+        cmd = cmd + [f"train.metrics_port={args.metrics_port}"]
     if args.elastic and not args.supervise:
         p.error("--elastic requires --supervise")
     if args.supervise:

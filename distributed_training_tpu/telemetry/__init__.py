@@ -14,6 +14,14 @@ One instrumentation surface, four consumers:
   step/data_wait exchange flagging persistently slow hosts;
 - ``audit_hlo_text`` (collectives.py) — static collective-traffic
   accounting of a compiled SPMD step (counts + bytes per mesh axis);
+- ``ProfileCapture`` (attribution.py) — in-run ``jax.profiler``
+  capture at configured steps (or a drop-file trigger) decomposed
+  into compute / collective / host+data + overlap %, and the static
+  schedule-overlap audit the analysis gate ratchets; trace parsing
+  lives in xplane.py (stdlib XSpace reader, shared with
+  benchmarks/analyze_trace.py);
+- ``MetricsServer`` (metrics_server.py) — the coordinator's live
+  Prometheus endpoint + /healthz, fed from this sink;
 - the multi-host aggregator (aggregate.py) — merges per-host
   ``host_<i>/events.jsonl`` streams into one clock-aligned report.
 
@@ -22,6 +30,10 @@ all (summarize.py; multi-host run dirs get the merged report). Event
 schema and bucket definitions: docs/observability.md.
 """
 
+from distributed_training_tpu.telemetry.attribution import (  # noqa: F401
+    ProfileCapture,
+    hlo_overlap_report,
+)
 from distributed_training_tpu.telemetry.collectives import (  # noqa: F401
     audit_hlo_text,
 )
@@ -38,6 +50,9 @@ from distributed_training_tpu.telemetry.goodput import (  # noqa: F401
 )
 from distributed_training_tpu.telemetry.hbm import (  # noqa: F401
     HBMSampler,
+)
+from distributed_training_tpu.telemetry.metrics_server import (  # noqa: F401
+    MetricsServer,
 )
 from distributed_training_tpu.telemetry.straggler import (  # noqa: F401
     StragglerDetector,

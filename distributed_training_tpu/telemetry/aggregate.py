@@ -41,7 +41,8 @@ from distributed_training_tpu.telemetry import collectives as collectives_lib
 from distributed_training_tpu.telemetry.goodput import goodput_of_stream
 from distributed_training_tpu.telemetry.straggler import flag_stragglers
 from distributed_training_tpu.telemetry.summarize import (
-    _loss_stats, _recovery, load_jsonl, render_recovery_lines)
+    _attribution, _attribution_static, _loss_stats, _recovery,
+    load_jsonl, render_attribution_lines, render_recovery_lines)
 
 # Bump when the aggregate summary's keys change meaning.
 SCHEMA = 1
@@ -270,6 +271,11 @@ def aggregate_run(run_dir: str, threshold: float | None = None) -> dict:
                              if runtime_events else None),
         },
         "collectives": coll,
+        # Step-time attribution (coordinator-emitted, telemetry/
+        # attribution.py): the measured capture + the static schedule
+        # audit. Additive keys — SCHEMA stays 1 (pinned by test).
+        "attribution": _attribution(merged),
+        "attribution_static": _attribution_static(merged),
         # Recovery/elastic accounting from the COORDINATOR's stream:
         # every host appends its own run_start/resume per incarnation,
         # so segmenting the merged timeline would count one restart N
@@ -355,6 +361,8 @@ def render_multihost(summary: dict) -> str:
     coll = summary.get("collectives")
     if coll:
         lines.extend(collectives_lib.render_lines(coll))
+    lines.extend(render_attribution_lines(
+        summary.get("attribution"), summary.get("attribution_static")))
     rec = summary.get("recovery")
     if rec:
         lines.extend(render_recovery_lines(rec))

@@ -1,0 +1,402 @@
+"""Step-time attribution: where a training step's wall-clock goes.
+
+Two complementary views, one module, shared schemas:
+
+**Measured** (``attribute_trace_dir`` + ``ProfileCapture``): the
+trainer captures a short ``jax.profiler`` trace mid-run — at
+configured steps (``train.profile_at``) or on demand (drop a
+``profile_now`` file in the run dir) — and immediately decomposes the
+captured device timeline (telemetry/xplane.py) into compute /
+exposed-collective / host+data fractions plus the **overlap
+fraction** (share of collective time concurrent with compute — comms
+the schedule actually hid). Emitted as an ``attribution`` event;
+rendered by the summarizer next to MFU. Capture is coordinator-gated,
+one-shot across supervisor restarts (the resilience/faults.py
+write-before-action ledger discipline: the trigger is recorded
+*before* the trace starts, so a crash mid-capture cannot re-fire it
+every incarnation), and the attribution work happens after the step
+span closes — it lands in the ``idle`` goodput bucket, never in
+``step``, so captured runs keep an honest goodput story.
+
+**Static** (``hlo_overlap_report``): overlap is a property of the
+compiled schedule (SimpleFSDP, arXiv 2411.00284 — comms/compute
+overlap comes from compiler passes, not hand scheduling), so it can
+be audited from optimized HLO with no chip at all. For every
+collective in a scheduled module this measures how much independent
+compute the schedule places between the collective's issue point and
+its first consumer — for async ``-start``/``-done`` pairs, between
+start and done; for sync-form collectives in a scheduled module
+(``is_scheduled=true``: textual order IS the schedule), between the
+op and the first use of its result. A collective with independent
+compute in that gap is one a latency-hiding backend can run under
+compute; one consumed immediately is exposed by construction. The
+per-module score (fraction of collectives with a nonempty gap) is
+ratcheted by the analysis gate against ``OVERLAP_baseline.json``
+(analysis/__main__.py), so a plan or model change that destroys
+overlap scheduling fails tier-1 without a TPU.
+
+The trainer also emits a one-shot ``attribution_static`` event from
+the same compiled HLO its ``collectives`` audit walks, with the
+planner roofline's expected comms/compute seconds as denominator
+context (parallel/planner.py score provenance, when a plan is
+pinned).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import re
+
+from distributed_training_tpu.telemetry import xplane
+
+logger = logging.getLogger(__name__)
+
+SCHEMA = 1
+
+# The stable consumer surface of a trainer-emitted ``attribution``
+# event (summarize.py / aggregate.py filter through this — the
+# collectives.SUMMARY_KEYS discipline, so single-host and multi-host
+# reports cannot drift).
+SUMMARY_KEYS = ("schema", "step", "steps_captured", "trace_dir",
+                "source", "window_s", "compute_frac",
+                "collective_frac", "host_frac", "overlap_frac",
+                "compute_s", "collective_s", "overlap_s", "error")
+
+# Same for the one-shot ``attribution_static`` event.
+STATIC_SUMMARY_KEYS = ("schema", "step", "scored", "overlapped",
+                       "overlap_score", "mean_compute_between",
+                       "async_pairs", "expected_comms_s",
+                       "expected_compute_s", "sharding_plan")
+
+
+def summary_of_event(rec: dict, keys=SUMMARY_KEYS) -> dict:
+    return {k: rec[k] for k in keys if k in rec}
+
+
+def attribute_trace_dir(trace_dir: str) -> dict:
+    """Attribution report for the newest ``.xplane.pb`` under
+    ``trace_dir`` (xplane.py arithmetic + provenance fields)."""
+    path = xplane.find_xplane(trace_dir)
+    rep = xplane.attribution_of_planes(xplane.load_xspace(path))
+    rep["xplane"] = path
+    return rep
+
+
+# ---------------------------------------------------------------------------
+# in-run capture
+# ---------------------------------------------------------------------------
+
+TRIGGER_FILE = "profile_now"
+
+
+def parse_profile_at(spec: str) -> tuple[int, ...]:
+    """``train.profile_at`` grammar: comma-separated global step
+    numbers (``"20"`` / ``"20,500"``). The capture begins at that
+    step and runs ``train.profile_steps`` steps."""
+    steps = []
+    for part in str(spec or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if not part.isdigit():
+            raise ValueError(
+                f"train.profile_at: {part!r} is not a step number "
+                "(grammar: comma-separated ints, e.g. '20,500')")
+        steps.append(int(part))
+    return tuple(sorted(set(steps)))
+
+
+class ProfileCapture:
+    """State machine for in-run trace capture + attribution.
+
+    The trainer calls ``maybe_start(step)`` before dispatching each
+    step and ``maybe_stop(step, sync=...)`` after its bookkeeping;
+    everything else — trigger evaluation (scheduled steps, the
+    drop-a-file trigger), the one-shot restart ledger, trace dir
+    naming, the attribution parse — lives here so it is testable
+    without a trainer. Failures never propagate: observability must
+    not take down the run it observes (the collectives-audit
+    discipline); a failed parse returns an event payload with an
+    ``error`` field instead.
+    """
+
+    def __init__(self, run_dir: str, at_steps=(), n_steps: int = 2,
+                 enabled: bool = True):
+        self.run_dir = run_dir
+        # The config layer yaml-parses `train.profile_at=20` into an
+        # int and `=20,500` into a string; accept both plus iterables.
+        self.at_steps = (parse_profile_at(str(at_steps))
+                         if isinstance(at_steps, (str, int)) else
+                         tuple(int(s) for s in at_steps))
+        self.n_steps = max(1, int(n_steps))
+        self.enabled = enabled
+        self.profiles_dir = os.path.join(run_dir, "profiles")
+        self.trigger_path = os.path.join(run_dir, TRIGGER_FILE)
+        self.ledger_path = os.path.join(self.profiles_dir,
+                                        "fired.json")
+        self._fired: set[str] = set()
+        self._active: dict | None = None
+        if enabled and os.path.exists(self.ledger_path):
+            try:
+                with open(self.ledger_path, encoding="utf-8") as f:
+                    self._fired = set(json.load(f))
+            except (OSError, ValueError) as e:
+                logger.warning("profile ledger unreadable (%s); "
+                               "treating all triggers as unfired", e)
+
+    # -- trigger ledger (write-before-action, faults.py discipline) ----
+
+    def _record_fired(self, key: str) -> None:
+        self._fired.add(key)
+        os.makedirs(self.profiles_dir, exist_ok=True)
+        tmp = self.ledger_path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(sorted(self._fired), f)
+        os.replace(tmp, self.ledger_path)
+
+    def _trigger(self, step: int) -> str | None:
+        """The trigger key firing at ``step``, or None. Scheduled
+        steps fire at-or-after (a resume may land past the exact
+        step) and are one-shot via the ledger; the drop-file trigger
+        is one-shot by consumption (re-dropping the file re-arms it,
+        which is the point of an on-demand trigger)."""
+        due = [s for s in self.at_steps
+               if step >= s and f"step_{s}" not in self._fired]
+        if due:
+            # All overdue triggers are satisfied by THIS capture: a
+            # resume landing past several profile_at steps must not
+            # run back-to-back redundant captures of the same code
+            # region, one per stale entry.
+            for s in due[1:]:
+                self._fired.add(f"step_{s}")
+            return f"step_{due[0]}"
+        if os.path.exists(self.trigger_path):
+            try:
+                os.remove(self.trigger_path)
+            except OSError:
+                return None  # another host consumed it first
+            return f"file_at_{step}"
+        return None
+
+    # -- capture lifecycle ---------------------------------------------
+
+    @property
+    def active(self) -> bool:
+        return self._active is not None
+
+    def maybe_start(self, step: int) -> bool:
+        """Start a capture if a trigger fires at ``step`` (the step
+        about to be dispatched). Returns whether a trace is now
+        recording."""
+        if not self.enabled or self._active is not None:
+            return False
+        key = self._trigger(step)
+        if key is None:
+            return False
+        trace_dir = os.path.join(self.profiles_dir, f"step_{step:06d}")
+        try:
+            # Ledger BEFORE the trace: a crash mid-capture must not
+            # re-fire the trigger every restarted incarnation.
+            self._record_fired(key)
+            import jax
+            os.makedirs(trace_dir, exist_ok=True)
+            jax.profiler.start_trace(trace_dir)
+        except Exception:  # noqa: BLE001 — e.g. a trace is already
+            # live via train.profile_dir; profiling is best-effort.
+            logger.exception("profile capture at step %d failed to "
+                             "start; continuing untraced", step)
+            return False
+        self._active = {"start_step": step, "dir": trace_dir,
+                        "remaining": self.n_steps, "trigger": key}
+        logger.info("profiling steps %d..%d into %s", step,
+                    step + self.n_steps - 1, trace_dir)
+        return True
+
+    def maybe_stop(self, step: int, sync=None) -> dict | None:
+        """Count down the active capture; when the window completes,
+        drain the device (``sync``), stop the trace, attribute it,
+        and return the ``attribution`` event payload."""
+        if self._active is None:
+            return None
+        self._active["remaining"] -= 1
+        if self._active["remaining"] > 0:
+            return None
+        active, self._active = self._active, None
+        payload = {"schema": SCHEMA, "step": step,
+                   "steps_captured": step - active["start_step"] + 1,
+                   "trace_dir": os.path.relpath(active["dir"],
+                                                self.run_dir),
+                   "trigger": active["trigger"]}
+        try:
+            import jax
+            if sync is not None:
+                # The traced steps dispatched async; the device work
+                # must land in the trace before stop. This drain is
+                # after the step span closed — it books to idle, not
+                # to the goodput step bucket.
+                sync()
+            jax.profiler.stop_trace()
+        except Exception as e:  # noqa: BLE001
+            logger.exception("profile capture failed to stop")
+            payload["error"] = f"stop_trace: {type(e).__name__}: {e}"
+            return payload
+        try:
+            payload.update(attribute_trace_dir(active["dir"]))
+            payload["schema"] = SCHEMA
+        except (xplane.XplaneError, OSError) as e:
+            payload["error"] = str(e)
+        return payload
+
+    def abort(self) -> None:
+        """Stop an in-flight trace without attributing (run ended
+        mid-window — preemption, eviction, crash teardown). The
+        partial trace stays on disk for offline analysis; the ledger
+        already recorded the trigger, so a restart won't re-fire."""
+        if self._active is None:
+            return
+        active, self._active = self._active, None
+        try:
+            import jax
+            jax.profiler.stop_trace()
+            logger.warning(
+                "run ended mid-capture; partial trace left at %s "
+                "(analyze offline: benchmarks/analyze_trace.py "
+                "--attribution)", active["dir"])
+        except Exception as e:  # noqa: BLE001
+            logger.debug("profile capture abort: %s: %s",
+                         type(e).__name__, e)
+
+
+# ---------------------------------------------------------------------------
+# static overlap audit of a compiled (scheduled) HLO module
+# ---------------------------------------------------------------------------
+
+OVERLAP_SCHEMA = 1
+
+# Opcodes that count as independent COMPUTE between a collective's
+# issue point and its consumer — work a latency-hiding scheduler can
+# run under the collective. Deliberately excludes data movement
+# (copy/bitcast/slice/tuple plumbing): shuffling bytes while a
+# collective is in flight does not hide its latency budget the way op
+# work does, and including it would let pure-plumbing gaps score as
+# overlap.
+COMPUTE_OPS = frozenset({
+    "fusion", "dot", "convolution", "custom-call", "reduce",
+    "reduce-window", "select-and-scatter", "scatter", "sort",
+    "cholesky", "triangular-solve", "fft", "rng", "rng-bit-generator",
+})
+
+_SYNC_COLLECTIVES = frozenset(
+    {"all-reduce", "all-gather", "reduce-scatter",
+     "collective-permute", "all-to-all"})
+_ASYNC_START = frozenset(f"{k}-start" for k in _SYNC_COLLECTIVES)
+
+# "  %name = TYPE opcode(" — instruction lines inside a computation.
+# TYPE is either a single "dt[shape]{layout}" token or a TUPLE —
+# possibly of tuples: a combiner-grouped async start over N operands
+# prints "((dt[s], dt[s]), (dt[s], dt[s]))". Both carry SPACES, and
+# a \S+ type matcher would silently drop exactly the instructions
+# the overlap audit exists to score (the collectives.py tuple-type
+# lesson, schedule edition); the alternation below accepts one level
+# of nesting, the deepest HLO result types go.
+_INSTR = re.compile(
+    r"^\s+(?:ROOT\s+)?%([\w.\-]+)\s*=\s*"
+    r"(?:\((?:[^()]|\([^()]*\))*\)|\S+)\s+([\w\-]+)\(")
+# The TPU pipeline's fused reduce-scatter (collectives.py rationale).
+_FUSED_RS = re.compile(r"calls=%all-reduce-scatter")
+
+
+def _uses(line: str, name: str) -> bool:
+    """Whether an instruction line consumes ``%name`` (exact operand
+    match; ``%ag.1`` must not match ``%ag.10``)."""
+    return re.search(r"%" + re.escape(name) + r"(?![\w.\-])",
+                     line) is not None
+
+
+def hlo_overlap_report(text: str) -> dict:
+    """Score how much independent compute the schedule places inside
+    each collective's latency window (module docstring). Sync-form
+    collectives are scored only in scheduled modules
+    (``is_scheduled=true``), where textual order is the schedule;
+    ``-start``/``-done`` pairs are scored always (hand-written or
+    dumped HLO included). Collectives whose consumer is outside the
+    scoring window (ROOT results, cross-computation uses) are counted
+    but excluded from the score."""
+    scheduled = "is_scheduled=true" in text[:2000]
+    pairs: list[dict] = []
+    unscored = 0
+    # Computation-by-computation: each computation's instruction list
+    # is its own schedule (collectives.py's block-splitting idiom).
+    for block in re.split(r"\n(?=%|ENTRY)", text):
+        instrs: list[tuple[str, str, str]] = []  # (name, opcode, line)
+        for line in block.splitlines():
+            m = _INSTR.match(line)
+            if m:
+                instrs.append((m.group(1), m.group(2), line))
+        # A fused reduce-scatter prints as a fusion, but it is COMMS:
+        # it must neither count as independent compute in another
+        # collective's gap (two back-to-back fused RS would score
+        # each other as overlap) nor be missed as a collective.
+        is_coll_fusion = [op == "fusion" and bool(_FUSED_RS.search(ln))
+                          for _n, op, ln in instrs]
+        for idx, (name, opcode, line) in enumerate(instrs):
+            is_async = opcode in _ASYNC_START
+            is_sync = (opcode in _SYNC_COLLECTIVES
+                       or is_coll_fusion[idx])
+            if not is_async and not is_sync:
+                continue
+            if is_sync and not scheduled:
+                unscored += 1
+                continue
+            kind = opcode[:-6] if is_async else (
+                "reduce-scatter" if opcode == "fusion" else opcode)
+            # The latency window closes at the matching -done (async)
+            # or at the first consumer of the result (sync form).
+            end = None
+            for j in range(idx + 1, len(instrs)):
+                _n2, op2, line2 = instrs[j]
+                if is_async:
+                    if op2 == f"{kind}-done" and _uses(line2, name):
+                        end = j
+                        break
+                elif _uses(line2, name):
+                    end = j
+                    break
+            if end is None:
+                unscored += 1
+                continue
+            between = sum(
+                1 for j in range(idx + 1, end)
+                if instrs[j][1] in COMPUTE_OPS
+                and not is_coll_fusion[j])
+            pairs.append({"kind": kind, "name": name,
+                          "compute_between": between,
+                          "form": "async" if is_async else
+                          "scheduled"})
+    scored = len(pairs)
+    overlapped = sum(1 for p in pairs if p["compute_between"] > 0)
+    return {
+        "schema": OVERLAP_SCHEMA,
+        "scheduled_module": scheduled,
+        "scored": scored,
+        "unscored": unscored,
+        "async_pairs": sum(1 for p in pairs if p["form"] == "async"),
+        "overlapped": overlapped,
+        "overlap_score": (round(overlapped / scored, 6)
+                          if scored else None),
+        "mean_compute_between": (round(
+            sum(p["compute_between"] for p in pairs) / scored, 3)
+            if scored else None),
+        "pairs": pairs,
+    }
+
+
+def overlap_summary(rep: dict) -> dict:
+    """The row the analysis gate ratchets and the audit doc embeds —
+    everything except the per-pair detail."""
+    return {k: rep[k] for k in
+            ("schema", "scheduled_module", "scored", "unscored",
+             "async_pairs", "overlapped", "overlap_score",
+             "mean_compute_between") if k in rep}

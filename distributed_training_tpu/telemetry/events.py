@@ -30,6 +30,7 @@ from __future__ import annotations
 import collections
 import contextlib
 import json
+import logging
 import os
 import threading
 import time
@@ -37,6 +38,8 @@ import time
 import jax
 
 from distributed_training_tpu.utils.metrics import sanitize_for_json
+
+logger = logging.getLogger(__name__)
 
 
 class Telemetry:
@@ -64,6 +67,7 @@ class Telemetry:
         self.host_id = host_id
         self._lock = threading.Lock()
         self._tls = threading.local()
+        self._observers: list = []
         self._tail: collections.deque = collections.deque(
             maxlen=tail_events)
         self.ledger = None  # GoodputLedger, attached by the trainer
@@ -91,6 +95,15 @@ class Telemetry:
         """Feed top-level span durations into a GoodputLedger."""
         self.ledger = ledger
 
+    def add_observer(self, fn) -> None:
+        """Register a live consumer of every emitted record (the
+        metrics endpoint, telemetry/metrics_server.py). Called with
+        the sanitized record AFTER it is written, outside the sink
+        lock; an observer that raises is logged and does not disturb
+        emission — the jsonl stream stays the source of truth."""
+        with self._lock:
+            self._observers.append(fn)
+
     def _emit(self, rec: dict) -> None:
         if not self.enabled:  # cheap fast path; authoritative below
             return
@@ -106,6 +119,14 @@ class Telemetry:
                 return
             self._tail.append(safe)
             self._fh.write(line + "\n")
+            observers = list(self._observers)
+        for fn in observers:
+            try:
+                fn(safe)
+            except Exception as e:  # noqa: BLE001 — a broken live
+                # consumer must not take down the emission path.
+                logger.debug("telemetry observer failed: %s: %s",
+                             type(e).__name__, e)
 
     def close(self) -> None:
         """Stop recording and release the stream handle (idempotent).

@@ -1,0 +1,313 @@
+"""Live metrics endpoint: Prometheus text exposition off the event sink.
+
+The serving fleet and the multi-job scheduler (ROADMAP items 1/5) need
+a machine-readable live view of every running job; scraping
+``events.jsonl`` off N hosts is not that. This module is a stdlib-only
+HTTP server the train CLI runs on the COORDINATOR
+(``train.metrics_port``), registered as an observer on the ambient
+``Telemetry`` sink — every gauge below is derived from records the
+sink already emits (goodput windows, spans, straggler verdicts,
+attribution events), so the endpoint and the jsonl stream can never
+disagree: one metrics source of truth, two transports.
+
+Endpoints:
+
+- ``GET /metrics`` — Prometheus text exposition (version 0.0.4):
+  ``dtt_step_time_seconds``, ``dtt_tokens_per_s``, ``dtt_mfu``,
+  ``dtt_goodput``, ``dtt_data_wait_seconds_total``,
+  ``dtt_overlap_fraction`` (measured; ``dtt_overlap_static_fraction``
+  for the compiled-schedule score), ``dtt_straggler_verdicts_total``,
+  ``dtt_world_size`` / ``dtt_incarnation`` (elastic machinery),
+  ``dtt_steps_total``, ``dtt_up``.
+- ``GET /healthz`` — 200 while the step loop makes progress; 503 once
+  no step has completed for longer than the stall threshold (the CLI
+  feeds ``train.watchdog_timeout_s``; the first step gets the same
+  10x compile allowance the watchdog gives it). Load balancers and
+  the fleet scheduler key off this.
+
+The observer callback runs on whatever thread emits the record and
+must stay cheap (dict updates); the HTTP side reads a snapshot under
+the same lock. Server failures (port taken, socket errors) log and
+disable — a metrics endpoint must never take down the run it reports
+on.
+"""
+
+from __future__ import annotations
+
+import http.server
+import json
+import logging
+import threading
+import time
+
+logger = logging.getLogger(__name__)
+
+PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+class MetricsServer:
+    """Prometheus endpoint fed by Telemetry records.
+
+    ``tokens_per_step`` converts step durations into a throughput
+    gauge (tokens == samples for non-token models); ``stall_timeout_s``
+    drives ``/healthz`` (0 = never unhealthy); ``info`` is static
+    run identity (world_size, incarnation, host) exported as gauges.
+    ``port=0`` binds an ephemeral port (tests); read ``.port`` after
+    ``start()``.
+    """
+
+    def __init__(self, port: int, telemetry=None,
+                 tokens_per_step: float = 0.0,
+                 stall_timeout_s: float = 0.0,
+                 info: dict | None = None,
+                 host: str = "0.0.0.0"):
+        self._requested_port = port
+        self._host = host
+        self.tokens_per_step = tokens_per_step
+        self.stall_timeout_s = stall_timeout_s
+        self._lock = threading.Lock()
+        self._gauges: dict[str, float] = {}
+        self._counters: dict[str, float] = {"steps_total": 0.0,
+                                            "straggler_verdicts_total":
+                                                0.0,
+                                            "data_wait_seconds_total":
+                                                0.0}
+        for k, v in (info or {}).items():
+            if isinstance(v, (int, float)):
+                self._gauges[k] = float(v)
+        self._started_at = time.monotonic()
+        self._last_step_at: float | None = None
+        self._last_progress_at: float | None = None
+        self._httpd = None
+        self._thread = None
+        self.port: int | None = None
+        # Observer registration happens in start(), AFTER a
+        # successful bind — a server whose port was taken must not
+        # keep folding every telemetry record for the rest of the
+        # run while serving nothing.
+        self._telemetry = telemetry
+
+    # -- feed ----------------------------------------------------------
+
+    def observe(self, rec: dict) -> None:
+        """Telemetry observer: fold one emitted record into the
+        gauges. Must not raise (the sink swallows, but cheap safety
+        beats a stack trace per step)."""
+        kind = rec.get("kind")
+        with self._lock:
+            if kind == "span":
+                # ANY main-loop span closing is liveness evidence —
+                # a run inside a long deliberate non-step phase
+                # (checkpoint drain, eval, a mid-run re-compile)
+                # is slow, not dead, and /healthz must not route
+                # traffic away from it. data_assemble is excluded:
+                # it closes on the prefetch thread, which can stay
+                # briefly alive after the main loop wedges.
+                if rec.get("name") != "data_assemble":
+                    self._last_progress_at = time.monotonic()
+            if kind == "span" and rec.get("name") in ("step",
+                                                      "compile"):
+                # The FIRST optimizer step dispatches under a
+                # "compile" span (trainer.py): it is still a
+                # completed step — counting only "step" spans would
+                # export steps_total = N-1 and hold the healthz
+                # first-step latch one step too long. Its duration is
+                # compile-dominated though, so the step-time/tokens
+                # gauges wait for a real "step" span.
+                self._counters["steps_total"] += 1
+                self._last_step_at = time.monotonic()
+            if kind == "span" and rec.get("name") == "step":
+                dur = rec.get("dur_s")
+                if isinstance(dur, (int, float)) and dur > 0:
+                    self._gauges["step_time_seconds"] = dur
+                    if self.tokens_per_step:
+                        self._gauges["tokens_per_s"] = (
+                            self.tokens_per_step / dur)
+            elif kind == "span" and rec.get("name") == "data_wait":
+                dur = rec.get("dur_s")
+                if isinstance(dur, (int, float)):
+                    self._counters["data_wait_seconds_total"] += dur
+            elif kind == "goodput":
+                for src, dst in (("mfu_wall", "mfu"),
+                                 ("goodput", "goodput")):
+                    if isinstance(rec.get(src), (int, float)):
+                        self._gauges[dst] = rec[src]
+            elif kind == "attribution":
+                for src, dst in (
+                        ("overlap_frac", "overlap_fraction"),
+                        ("compute_frac", "compute_fraction"),
+                        ("collective_frac", "collective_fraction"),
+                        ("host_frac", "host_fraction")):
+                    if isinstance(rec.get(src), (int, float)):
+                        self._gauges[dst] = rec[src]
+            elif kind == "attribution_static":
+                if isinstance(rec.get("overlap_score"), (int, float)):
+                    self._gauges["overlap_static_fraction"] = \
+                        rec["overlap_score"]
+            elif kind == "straggler":
+                persistent = rec.get("persistent") or []
+                self._gauges["straggler_flagged"] = float(
+                    len(persistent))
+                if persistent:
+                    self._counters["straggler_verdicts_total"] += len(
+                        persistent)
+            elif kind == "resume":
+                if isinstance(rec.get("world_size"), int):
+                    self._gauges["world_size"] = rec["world_size"]
+                if isinstance(rec.get("restarts"), int):
+                    self._gauges["incarnation"] = rec["restarts"]
+            elif kind == "clock_sync":
+                if isinstance(rec.get("process_count"), int):
+                    self._gauges.setdefault(
+                        "world_size", float(rec["process_count"]))
+            elif kind == "collectives":
+                if isinstance(rec.get("bytes_per_step"), (int, float)):
+                    self._gauges["collective_bytes_per_step"] = \
+                        rec["bytes_per_step"]
+
+    # -- health --------------------------------------------------------
+
+    def health(self) -> tuple[bool, dict]:
+        """(healthy, detail). Unhealthy only when a stall threshold is
+        configured and the step loop has been silent past it — with
+        the watchdog's 10x first-step (compile) allowance before the
+        first step lands."""
+        with self._lock:
+            first_step_done = self._last_step_at is not None
+            last = self._last_progress_at
+            steps = self._counters["steps_total"]
+        now = time.monotonic()
+        detail: dict = {"steps": int(steps)}
+        if not self.stall_timeout_s:
+            return True, {**detail, "status": "ok",
+                          "stall_watch": "disabled"}
+        if not first_step_done:
+            budget = self.stall_timeout_s * 10
+            silent = now - (last if last is not None
+                            else self._started_at)
+            detail["status"] = "starting"
+        else:
+            budget = self.stall_timeout_s
+            silent = now - (last if last is not None else
+                            self._started_at)
+            detail["status"] = "ok"
+        detail["silent_s"] = round(silent, 3)
+        if silent > budget:
+            detail["status"] = "stalled"
+            detail["stall_threshold_s"] = budget
+            return False, detail
+        return True, detail
+
+    # -- render --------------------------------------------------------
+
+    _HELP = {
+        "step_time_seconds": "Last completed optimizer step duration",
+        "tokens_per_s": "Throughput from the last step "
+                        "(tokens == samples for non-token models)",
+        "mfu": "Wall-clock MFU of the last goodput window",
+        "goodput": "Step seconds / wall seconds, last goodput window",
+        "overlap_fraction": "Measured share of collective time hidden "
+                            "under compute (last attribution capture)",
+        "overlap_static_fraction": "Compiled-schedule overlap score "
+                                   "(attribution_static)",
+        "compute_fraction": "Measured compute share of step time",
+        "collective_fraction": "Measured exposed-collective share",
+        "host_fraction": "Measured host/data share of step time",
+        "world_size": "Process count of the current incarnation",
+        "incarnation": "Supervisor restart count of this incarnation",
+        "straggler_flagged": "Hosts flagged in the last straggler "
+                             "exchange",
+        "collective_bytes_per_step": "Static per-step collective "
+                                     "traffic (bytes/participant)",
+        "steps_total": "Optimizer steps completed this incarnation",
+        "data_wait_seconds_total": "Cumulative host time blocked on "
+                                   "the input pipeline",
+        "straggler_verdicts_total": "Cumulative persistent straggler "
+                                    "verdicts observed",
+        "up": "1 while the run is serving metrics",
+    }
+
+    def render(self) -> str:
+        """The /metrics payload (Prometheus text format 0.0.4)."""
+        with self._lock:
+            gauges = dict(self._gauges)
+            counters = dict(self._counters)
+        gauges["up"] = 1.0
+        lines: list[str] = []
+        for name, value in sorted(gauges.items()):
+            full = f"dtt_{name}"
+            lines.append(f"# HELP {full} {self._HELP.get(name, name)}")
+            lines.append(f"# TYPE {full} gauge")
+            lines.append(f"{full} {_fmt(value)}")
+        for name, value in sorted(counters.items()):
+            full = f"dtt_{name}"
+            lines.append(f"# HELP {full} {self._HELP.get(name, name)}")
+            lines.append(f"# TYPE {full} counter")
+            lines.append(f"{full} {_fmt(value)}")
+        return "\n".join(lines) + "\n"
+
+    # -- HTTP ----------------------------------------------------------
+
+    def start(self):
+        """Bind + serve on a daemon thread. Returns self, or None when
+        the bind fails (logged; the run continues unmetered)."""
+        server = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 — http.server API
+                if self.path.split("?")[0] == "/metrics":
+                    body = server.render().encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     PROM_CONTENT_TYPE)
+                elif self.path.split("?")[0] == "/healthz":
+                    ok, detail = server.health()
+                    body = (json.dumps(detail) + "\n").encode()
+                    self.send_response(200 if ok else 503)
+                    self.send_header("Content-Type",
+                                     "application/json")
+                else:
+                    body = b"not found; try /metrics or /healthz\n"
+                    self.send_response(404)
+                    self.send_header("Content-Type", "text/plain")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, fmt, *args):
+                logger.debug("metrics http: " + fmt, *args)
+
+        try:
+            self._httpd = http.server.ThreadingHTTPServer(
+                (self._host, self._requested_port), Handler)
+        except OSError as e:
+            logger.warning(
+                "metrics endpoint NOT started (port %s): %s — the "
+                "run continues without /metrics",
+                self._requested_port, e)
+            return None
+        self.port = self._httpd.server_address[1]
+        if self._telemetry is not None:
+            self._telemetry.add_observer(self.observe)
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name="metrics-server", daemon=True)
+        self._thread.start()
+        logger.info("metrics endpoint on :%d (/metrics, /healthz)",
+                    self.port)
+        return self
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+
+def _fmt(v: float) -> str:
+    if v == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    return repr(float(v))

@@ -81,6 +81,67 @@ def _collectives(events: list[dict]) -> dict | None:
     return summary_of_event(rows[-1])
 
 
+def _attribution(events: list[dict]) -> dict | None:
+    """Latest in-run step-time attribution (trainer-emitted
+    ``attribution`` event, telemetry/attribution.py schema)."""
+    rows = [e for e in events if e.get("kind") == "attribution"]
+    if not rows:
+        return None
+    from distributed_training_tpu.telemetry.attribution import (
+        summary_of_event)
+    return summary_of_event(rows[-1])
+
+
+def _attribution_static(events: list[dict]) -> dict | None:
+    """Latest compiled-schedule overlap audit (``attribution_static``
+    event — one-shot after first compile)."""
+    rows = [e for e in events
+            if e.get("kind") == "attribution_static"]
+    if not rows:
+        return None
+    from distributed_training_tpu.telemetry.attribution import (
+        STATIC_SUMMARY_KEYS, summary_of_event)
+    return summary_of_event(rows[-1], keys=STATIC_SUMMARY_KEYS)
+
+
+def render_attribution_lines(att: dict | None,
+                             static: dict | None) -> list[str]:
+    """Attribution lines — shared by the single-run report and the
+    multi-host aggregate so the two renderings cannot drift."""
+    lines: list[str] = []
+    if att and att.get("error"):
+        lines.append(
+            f"attribution (step {att.get('step')}): capture failed — "
+            f"{att['error']}")
+    elif att:
+        lines.append(
+            f"attribution (step {att.get('step')}, "
+            f"{att.get('steps_captured')} step(s), "
+            f"{att.get('source')} timeline): "
+            f"compute {att.get('compute_frac', 0):.1%} / "
+            f"collective {att.get('collective_frac', 0):.1%} / "
+            f"host+data {att.get('host_frac', 0):.1%}; "
+            f"overlap {att.get('overlap_frac', 0):.1%} of collective "
+            f"time hidden")
+        if att.get("trace_dir"):
+            lines.append(f"  trace: {att['trace_dir']}")
+    if static and static.get("scored"):
+        line = (
+            f"static overlap (compiled schedule): "
+            f"{static['overlap_score']:.2f} of {static['scored']} "
+            f"collective(s) scheduled with independent compute "
+            f"(mean {static.get('mean_compute_between', 0):.1f} "
+            f"op(s))")
+        if isinstance(static.get("expected_comms_s"), (int, float)):
+            line += (f"; roofline expects comms "
+                     f"{static['expected_comms_s'] * 1e3:.3f}ms vs "
+                     f"compute "
+                     f"{static.get('expected_compute_s', 0) * 1e3:.3f}"
+                     "ms/step")
+        lines.append(line)
+    return lines
+
+
 def _hbm(events: list[dict]) -> dict | None:
     """Per-device high-water marks over all hbm samples."""
     peak: dict[int, int] = {}
@@ -273,6 +334,8 @@ def summarize_run(run_dir: str) -> dict:
         "goodput": _goodput(events),
         "hbm": _hbm(events),
         "collectives": _collectives(events),
+        "attribution": _attribution(events),
+        "attribution_static": _attribution_static(events),
         "recovery": _recovery(events),
         "spans": _spans(events),
         "watchdog_firings": [e for e in events
@@ -396,6 +459,11 @@ def render(summary: dict) -> str:
                 f"  ~{coll['bytes_per_step'] / mean_step / 1e9:.2f} "
                 f"GB/s sustained over {mean_step * 1e3:.1f}ms steps")
         lines.extend(axis_lines)
+    # Step-time attribution next to MFU: where the measured step went
+    # (compute / exposed collective / host+data, overlap hidden) and
+    # what the compiled schedule statically promises.
+    lines.extend(render_attribution_lines(
+        summary.get("attribution"), summary.get("attribution_static")))
     if spans:
         lines.append("spans (count / total / max):")
         for name in sorted(spans, key=lambda n: -spans[n]["total_s"]):

@@ -1,0 +1,614 @@
+"""XSpace (``.xplane.pb``) access: the one xplane parsing surface.
+
+``jax.profiler`` traces land on disk as XSpace protobufs. Two
+consumers used to read them in two different ways — the offline
+``benchmarks/analyze_trace.py`` through the ``xprof`` pip package, and
+nothing at runtime at all (the trainer could *capture* a trace but
+never look inside it). This module is the shared implementation both
+ride (the ``plan_memory.py``-over-planner precedent):
+
+- a **stdlib-only wire-format reader** (``parse_xspace`` /
+  ``load_xspace``) that decodes the XPlane schema directly from
+  protobuf wire bytes — no ``xprof``, no ``tensorflow``, no generated
+  protos. The runtime attribution path (telemetry/attribution.py)
+  must work inside the trainer on any backend, and the container's
+  tensorboard_plugin_profile vintage is protobuf-incompatible anyway;
+- ``timeline_lanes`` — the device-op lanes of a trace (``/device:*``
+  planes' "XLA Ops" lines when present; the host plane's XLA executor
+  lanes as the CPU-platform fallback, where XLA ops run on host
+  threadpools), with python-frame and profiler-infrastructure events
+  filtered out;
+- ``attribution_of_lanes`` — interval arithmetic over those lanes:
+  step time decomposed into compute / exposed-collective / host+data,
+  plus the **overlap fraction** (share of collective time concurrent
+  with compute — comms the schedule actually hid);
+- the ``xprof``-backed ``op_rows`` / ``op_category`` (moved verbatim
+  from analyze_trace.py) for the per-op self-time view, raising a
+  typed ``XplaneError`` with an actionable message when the package
+  is missing or incompatible instead of a raw ImportError traceback;
+- ``encode_xspace`` — the matching minimal encoder, so tests can
+  synthesize device timelines with known intervals and pin the
+  attribution arithmetic to exact expected fractions.
+
+Times: XPlane stores a line-level ``timestamp_ns`` plus per-event
+``offset_ps``/``duration_ps``. Everything here computes in integer
+picoseconds (exact) and converts to seconds only at the report edge.
+
+Proto field numbers (tensorflow/tsl/profiler/protobuf/xplane.proto):
+XSpace.planes=1; XPlane.name=2/.lines=3/.event_metadata=4;
+XLine.name=2/.display_name=11/.timestamp_ns=3/.events=4;
+XEvent.metadata_id=1/.offset_ps=2/.duration_ps=3;
+XEventMetadata.id=1/.name=2.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+from dataclasses import dataclass, field
+
+SCHEMA = 1
+
+
+class XplaneError(RuntimeError):
+    """A trace-tooling failure with its remedy in the message (the
+    analyze_trace CLI prints it and exits nonzero; runtime attribution
+    degrades to an ``error`` field on the event)."""
+
+
+# ---------------------------------------------------------------------------
+# protobuf wire format (decode + encode) — stdlib only
+# ---------------------------------------------------------------------------
+
+
+def _read_varint(buf: bytes, i: int) -> tuple[int, int]:
+    out = shift = 0
+    while True:
+        b = buf[i]
+        i += 1
+        out |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return out, i
+        shift += 7
+
+
+def _fields(buf: bytes):
+    """Yield ``(field_number, wire_type, value)`` triples; varints come
+    back as ints, length-delimited fields as bytes, fixed32/64 as raw
+    bytes. Unknown wire types abort the message (corrupt input)."""
+    i, n = 0, len(buf)
+    while i < n:
+        key, i = _read_varint(buf, i)
+        fn, wt = key >> 3, key & 7
+        if fn == 0:
+            # Protobuf field numbers start at 1; 0 means the cursor
+            # landed in garbage.
+            raise XplaneError(
+                f"protobuf field number 0 at byte {i} — corrupt or "
+                "not an XSpace file?")
+        if wt == 0:
+            v, i = _read_varint(buf, i)
+        elif wt == 1:
+            v, i = buf[i:i + 8], i + 8
+        elif wt == 2:
+            ln, i = _read_varint(buf, i)
+            v, i = buf[i:i + ln], i + ln
+            if len(v) < ln:
+                # Slicing past the end is silent in Python — a
+                # truncated file must fail loudly, not decode a
+                # partial payload as a shorter message.
+                raise XplaneError(
+                    f"truncated length-delimited field at byte {i} "
+                    f"(need {ln} bytes)")
+        elif wt == 5:
+            v, i = buf[i:i + 4], i + 4
+        else:
+            raise XplaneError(
+                f"unsupported protobuf wire type {wt} at byte {i} — "
+                "not an XSpace file?")
+        yield fn, wt, v
+
+
+def _enc_varint(n: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        out.append(b | (0x80 if n else 0))
+        if not n:
+            return bytes(out)
+
+
+def _enc_field(fn: int, payload: bytes) -> bytes:
+    """Length-delimited field (wire type 2)."""
+    return _enc_varint((fn << 3) | 2) + _enc_varint(len(payload)) \
+        + payload
+
+
+def _enc_varint_field(fn: int, value: int) -> bytes:
+    return _enc_varint(fn << 3) + _enc_varint(value)
+
+
+# ---------------------------------------------------------------------------
+# decoded model
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class Event:
+    """One timeline event, absolute times in integer picoseconds."""
+
+    name: str
+    start_ps: int
+    dur_ps: int
+
+    @property
+    def end_ps(self) -> int:
+        return self.start_ps + self.dur_ps
+
+
+@dataclass
+class Lane:
+    """One XLine: a thread / device stream of non-nested events."""
+
+    name: str
+    events: list[Event] = field(default_factory=list)
+
+
+@dataclass
+class Plane:
+    """One XPlane (a host or a device)."""
+
+    name: str
+    lanes: list[Lane] = field(default_factory=list)
+
+
+def parse_xspace(data: bytes) -> list[Plane]:
+    """Decode XSpace wire bytes into planes/lanes/events."""
+    planes: list[Plane] = []
+    for fn, _wt, v in _fields(data):
+        if fn != 1:  # XSpace.planes
+            continue
+        name = ""
+        raw_lines: list[bytes] = []
+        emeta: dict[int, str] = {}
+        for f2, _w2, v2 in _fields(v):
+            if f2 == 2:
+                name = v2.decode("utf-8", "replace")
+            elif f2 == 3:
+                raw_lines.append(v2)
+            elif f2 == 4:  # map<int64, XEventMetadata>
+                k, meta = None, b""
+                for f3, _w3, v3 in _fields(v2):
+                    if f3 == 1:
+                        k = v3
+                    elif f3 == 2:
+                        meta = v3
+                mname = ""
+                for f4, _w4, v4 in _fields(meta):
+                    if f4 == 2:
+                        mname = v4.decode("utf-8", "replace")
+                if k is not None:
+                    emeta[k] = mname
+        plane = Plane(name=name)
+        for raw in raw_lines:
+            lname = disp = ""
+            ts_ns = 0
+            raw_events: list[bytes] = []
+            for f3, _w3, v3 in _fields(raw):
+                if f3 == 2:
+                    lname = v3.decode("utf-8", "replace")
+                elif f3 == 11:
+                    disp = v3.decode("utf-8", "replace")
+                elif f3 == 3:
+                    ts_ns = v3
+                elif f3 == 4:
+                    raw_events.append(v3)
+            lane = Lane(name=disp or lname)
+            base_ps = ts_ns * 1000
+            for raw_e in raw_events:
+                mid = off_ps = dur_ps = 0
+                for f4, _w4, v4 in _fields(raw_e):
+                    if f4 == 1:
+                        mid = v4
+                    elif f4 == 2:
+                        off_ps = v4
+                    elif f4 == 3:
+                        dur_ps = v4
+                lane.events.append(Event(
+                    name=emeta.get(mid, ""),
+                    start_ps=base_ps + off_ps, dur_ps=dur_ps))
+            plane.lanes.append(lane)
+        planes.append(plane)
+    return planes
+
+
+def load_xspace(path: str) -> list[Plane]:
+    try:
+        with open(path, "rb") as f:
+            data = f.read()
+        return parse_xspace(data)
+    except XplaneError:
+        raise
+    except Exception as e:  # noqa: BLE001 — a truncated/corrupt file
+        # misaligns the wire parse into arbitrary exception types
+        # (TypeError from a bytes-typed varint field, IndexError off
+        # the end, ...); all of them mean one thing to the caller,
+        # and the runtime consumer (ProfileCapture) must be able to
+        # catch ONE typed error — a raw parse crash propagating into
+        # the step loop would violate the attribution contract.
+        raise XplaneError(
+            f"cannot decode {path} as an XSpace protobuf "
+            f"({type(e).__name__}: {e})") from e
+
+
+def encode_xspace(planes: list[Plane]) -> bytes:
+    """Serialize planes back to XSpace wire bytes. Fixture writer for
+    tests (synthesized timelines with exact known intervals); the
+    output round-trips through ``parse_xspace``. Each lane keeps
+    ``timestamp_ns = 0`` — event starts are encoded as absolute
+    offsets, which the parser reads back identically."""
+    space = bytearray()
+    for plane in planes:
+        pb = bytearray()
+        pb += _enc_field(2, plane.name.encode())
+        names: dict[str, int] = {}
+        for lane in plane.lanes:
+            for ev in lane.events:
+                names.setdefault(ev.name, len(names) + 1)
+        for name, mid in names.items():
+            meta = (_enc_varint_field(1, mid)
+                    + _enc_field(2, name.encode()))
+            pb += _enc_field(4, _enc_varint_field(1, mid)
+                             + _enc_field(2, meta))
+        for lane in plane.lanes:
+            lb = bytearray()
+            lb += _enc_field(2, lane.name.encode())
+            lb += _enc_varint_field(3, 0)  # timestamp_ns
+            for ev in lane.events:
+                eb = (_enc_varint_field(1, names[ev.name])
+                      + _enc_varint_field(2, ev.start_ps)
+                      + _enc_varint_field(3, ev.dur_ps))
+                lb += _enc_field(4, eb)
+            pb += _enc_field(3, bytes(lb))
+        space += _enc_field(1, bytes(pb))
+    return bytes(space)
+
+
+# ---------------------------------------------------------------------------
+# locating traces
+# ---------------------------------------------------------------------------
+
+
+def find_xplane(trace_dir: str) -> str:
+    hits = sorted(glob.glob(os.path.join(
+        trace_dir, "**", "*.xplane.pb"), recursive=True))
+    if not hits:
+        raise XplaneError(
+            f"no .xplane.pb under {trace_dir} — pass the dir given to "
+            "jax.profiler.trace / profile_step.py --trace")
+    return hits[-1]  # latest session
+
+
+# ---------------------------------------------------------------------------
+# timeline extraction + classification
+# ---------------------------------------------------------------------------
+
+# Collective patterns FIRST: they embed 'gather'/'scatter' as
+# substrings (see op_category below, same rationale).
+COLLECTIVE_PATTERNS = ("all-to-all", "all-reduce", "all-gather",
+                       "reduce-scatter", "collective", "permute")
+
+# Profiler / executor scaffolding on host lanes — present in the
+# timeline but not op work; counting it as compute would book the
+# runtime's own bookkeeping as device-busy time.
+_INFRA_PREFIXES = ("ThreadpoolListener", "ThunkExecutor", "TfrtCpu",
+                   "PjitFunction", "ParseArguments", "Pjrt", "RunId",
+                   "DevicePut", "np.asarray")
+# Host events marking "XLA is executing a program here": on the CPU
+# platform ops run on host threads — the calling thread (tiny
+# programs execute inline, interleaved with python frames) or Eigen
+# threadpool lanes — and the only robust way to separate op events
+# from python frames and telemetry trace annotations ("step",
+# "data_wait" spans are TraceAnnotations too) is containment inside
+# one of these executor windows.
+_EXEC_WINDOW_PREFIXES = ("TfrtCpuExecutable::Execute",
+                         "ThunkExecutor::Execute")
+
+
+# The repo's own telemetry span names (events.py opens a
+# TraceAnnotation per span, so these ARE on the host timeline):
+# window markers, never op work — classifying a "step" annotation as
+# compute would book the whole step busy.
+_TELEMETRY_SPANS = frozenset({
+    "step", "compile", "data_wait", "data_assemble", "eval",
+    "ckpt_save", "ckpt_restore", "ckpt_wait", "collectives_audit"})
+
+
+def classify_event(name: str) -> str | None:
+    """``"collective"`` / ``"compute"`` for op events, None for
+    profiler/executor scaffolding, python frames, and the repo's own
+    span annotations."""
+    if not name or name.startswith("$") or name in _TELEMETRY_SPANS:
+        return None
+    for p in _INFRA_PREFIXES:
+        if name.startswith(p):
+            return None
+    low = name.lower()
+    for p in COLLECTIVE_PATTERNS:
+        if p in low:
+            return "collective"
+    return "compute"
+
+
+def _contained_filter(events: list["Event"],
+                      windows: list[tuple[int, int]]) -> list["Event"]:
+    """Events lying fully inside one of the merged windows."""
+    import bisect
+    starts = [w[0] for w in windows]
+    out = []
+    for ev in events:
+        i = bisect.bisect_right(starts, ev.start_ps) - 1
+        if i >= 0 and ev.end_ps <= windows[i][1]:
+            out.append(ev)
+    return out
+
+
+def timeline_events(planes: list[Plane]) -> tuple[list[Event], str,
+                                                  int]:
+    """The op events attribution should measure: ``(events, source,
+    lane_count)`` with ``source`` "device" or "host".
+
+    Device planes win: each contributes its "XLA Ops" line when one
+    exists (the per-op device timeline; other lines — "Steps", "XLA
+    Modules" — cover the same wall-clock at coarser granularity and
+    would double-count), else all its lines. A CPU-platform trace has
+    no device planes — XLA ops run on host threads — so the fallback
+    takes every ``/host:`` plane event that sits INSIDE an XLA
+    executor window (python frames and telemetry span annotations
+    either carry the ``$`` frame prefix or contain/straddle the
+    window rather than sitting inside it), mirroring analyze_trace's
+    Device→Host fallthrough. Hosts without recognizable executor
+    windows (a foreign vintage) keep every classifiable event —
+    honest best-effort over silence.
+    """
+    device = [p for p in planes if p.name.startswith("/device:")]
+    if device:
+        lanes: list[Lane] = []
+        for p in device:
+            ops = [ln for ln in p.lanes if ln.name == "XLA Ops"]
+            lanes.extend(ops if ops else p.lanes)
+        return ([ev for ln in lanes for ev in ln.events], "device",
+                len(lanes))
+    host_lanes = [ln for p in planes
+                  if p.name.startswith("/host:") for ln in p.lanes]
+    events = [ev for ln in host_lanes for ev in ln.events]
+    windows = _union([(ev.start_ps, ev.end_ps) for ev in events
+                      if any(ev.name.startswith(p)
+                             for p in _EXEC_WINDOW_PREFIXES)])
+    if windows:
+        events = _contained_filter(events, windows)
+    return events, "host", len(host_lanes)
+
+
+# ---------------------------------------------------------------------------
+# interval arithmetic (integer picoseconds, exact)
+# ---------------------------------------------------------------------------
+
+
+def _union(intervals: list[tuple[int, int]]) -> list[tuple[int, int]]:
+    """Merged, sorted, disjoint intervals."""
+    out: list[tuple[int, int]] = []
+    for s, e in sorted(i for i in intervals if i[1] > i[0]):
+        if out and s <= out[-1][1]:
+            out[-1] = (out[-1][0], max(out[-1][1], e))
+        else:
+            out.append((s, e))
+    return out
+
+
+def _measure(merged: list[tuple[int, int]]) -> int:
+    return sum(e - s for s, e in merged)
+
+
+def _intersect_measure(a: list[tuple[int, int]],
+                       b: list[tuple[int, int]]) -> int:
+    """Total overlap between two merged interval lists."""
+    i = j = total = 0
+    while i < len(a) and j < len(b):
+        s = max(a[i][0], b[j][0])
+        e = min(a[i][1], b[j][1])
+        if e > s:
+            total += e - s
+        if a[i][1] <= b[j][1]:
+            i += 1
+        else:
+            j += 1
+    return total
+
+
+def attribution_of_events(events: list[Event], source: str = "",
+                          lanes: int = 0, classify=classify_event,
+                          window: tuple[int, int] | None = None
+                          ) -> dict:
+    """Decompose a captured window into compute / collective / host.
+
+    Definitions (all unions taken ACROSS lanes, so concurrent streams
+    are measured once):
+
+    - window   = [earliest op start, latest op end], widened by
+      ``window`` when given (the capture's step/data_wait annotation
+      extent — without it, host time BEFORE the first op of the
+      first captured step would silently fall outside the window and
+      an input-bound run would report a near-zero host fraction);
+    - compute  = union of compute-op intervals — includes time where a
+      collective ran concurrently (that is comms the schedule HID);
+    - collective (exposed) = collective-op time NOT under compute —
+      the step time communication actually costs;
+    - host     = window minus all op time — the device waiting on
+      host/data;
+    - overlap_frac = (collective ∩ compute) / total collective time —
+      the share of comms hidden under compute (0.0 with no
+      collectives).
+
+    ``compute_frac + collective_frac + host_frac == 1`` exactly, by
+    construction.
+    """
+    comp: list[tuple[int, int]] = []
+    coll: list[tuple[int, int]] = []
+    n_events = 0
+    for ev in events:
+        kind = classify(ev.name)
+        if kind is None:
+            continue
+        n_events += 1
+        (coll if kind == "collective" else comp).append(
+            (ev.start_ps, ev.end_ps))
+    comp_u, coll_u = _union(comp), _union(coll)
+    busy_u = _union(comp + coll)
+    base = {"schema": SCHEMA, "source": source, "lanes": lanes}
+    if not busy_u:
+        w = ((window[1] - window[0]) * 1e-12) if window else 0.0
+        return {**base, "window_s": round(w, 9), "busy_s": 0.0,
+                "compute_s": 0.0, "collective_s": 0.0,
+                "overlap_s": 0.0, "compute_frac": 0.0,
+                "collective_frac": 0.0, "host_frac": 1.0,
+                "overlap_frac": 0.0, "events": 0}
+    t0 = busy_u[0][0]
+    t1 = busy_u[-1][1]
+    if window is not None:
+        # Only widen — a marker narrower than the op extent must not
+        # clip real op time out of the denominator.
+        t0, t1 = min(t0, window[0]), max(t1, window[1])
+    window = t1 - t0
+    compute_ps = _measure(comp_u)
+    coll_total_ps = _measure(coll_u)
+    overlap_ps = _intersect_measure(comp_u, coll_u)
+    exposed_ps = coll_total_ps - overlap_ps
+    busy_ps = _measure(busy_u)
+    ps = 1e-12
+
+    def frac(x: int) -> float:
+        return round(x / window, 6) if window else 0.0
+
+    return {
+        **base,
+        "window_s": round(window * ps, 9),
+        "busy_s": round(busy_ps * ps, 9),
+        "compute_s": round(compute_ps * ps, 9),
+        "collective_s": round(coll_total_ps * ps, 9),
+        "overlap_s": round(overlap_ps * ps, 9),
+        "compute_frac": frac(compute_ps),
+        "collective_frac": frac(exposed_ps),
+        "host_frac": frac(window - busy_ps),
+        "overlap_frac": (round(overlap_ps / coll_total_ps, 6)
+                         if coll_total_ps else 0.0),
+        "events": n_events,
+    }
+
+
+# The telemetry span names whose TraceAnnotations bound a captured
+# step on the host timeline (events.py emits every span as an
+# annotation, so they are IN the trace): used to widen the
+# attribution window so host/data time before the first device op —
+# the input-bound case attribution exists to diagnose — is counted.
+WINDOW_MARKERS = frozenset({"step", "data_wait", "compile"})
+
+
+def annotation_window(planes: list[Plane]) -> tuple[int, int] | None:
+    """Extent of the capture's step/data_wait annotations across host
+    planes; None when the trace has none (offline fixtures)."""
+    t0 = t1 = None
+    for p in planes:
+        if not p.name.startswith("/host:"):
+            continue
+        for ln in p.lanes:
+            for ev in ln.events:
+                if ev.name not in WINDOW_MARKERS:
+                    continue
+                t0 = ev.start_ps if t0 is None else min(t0,
+                                                        ev.start_ps)
+                t1 = ev.end_ps if t1 is None else max(t1, ev.end_ps)
+    return None if t0 is None else (t0, t1)
+
+
+def attribution_of_planes(planes: list[Plane]) -> dict:
+    """Attribution straight from decoded planes — the composition
+    every consumer (runtime capture, analyze_trace --attribution)
+    uses, so lane selection and arithmetic cannot drift apart."""
+    events, source, lanes = timeline_events(planes)
+    return attribution_of_events(events, source=source, lanes=lanes,
+                                 window=annotation_window(planes))
+
+
+# ---------------------------------------------------------------------------
+# xprof-backed per-op self-time rows (moved from analyze_trace.py)
+# ---------------------------------------------------------------------------
+
+
+def op_rows(xplane_path: str) -> list[dict]:
+    """Per-op self-time rows from the framework_op_stats tool (via the
+    standalone ``xprof`` package — the tensorboard_plugin_profile in
+    this image is protobuf-incompatible). Raises ``XplaneError`` with
+    the remedy when the package is missing or cannot read the trace."""
+    try:
+        from xprof.convert import raw_to_tool_data
+    except ImportError as e:
+        raise XplaneError(
+            "the per-op self-time view needs the standalone `xprof` "
+            f"package, which is not importable here ({e}). Install it "
+            "(`pip install xprof`) or use the dependency-free "
+            "attribution view (`analyze_trace.py --attribution`, "
+            "telemetry/xplane.py), which reads the trace directly."
+        ) from e
+    try:
+        data, _ = raw_to_tool_data.xspace_to_tool_data(
+            [xplane_path], "framework_op_stats", {"tqx": "out:json;"})
+        tables = json.loads(data)
+    except Exception as e:  # noqa: BLE001 — version drift inside
+        # xprof/protobuf surfaces as assorted exception types; all
+        # mean the same thing to the operator.
+        raise XplaneError(
+            f"xprof could not convert {xplane_path} "
+            f"({type(e).__name__}: {e}) — likely an xprof/protobuf "
+            "version mismatch; `pip install -U xprof` or fall back "
+            "to `analyze_trace.py --attribution`.") from e
+    # First table = the op breakdown (subsequent ones are summaries).
+    table = tables[0] if isinstance(tables, list) else tables
+    cols = [c["label"] for c in table["cols"]]
+    rows = []
+    for r in table["rows"]:
+        # gviz represents empty cells as nulls in the 'c' array.
+        vals = [(c or {}).get("v") for c in r["c"]]
+        rows.append(dict(zip(cols, vals)))
+    return rows
+
+
+def op_category(row: dict) -> str:
+    """Subsystem label for one op row. Prefers the tool's own Category
+    column (lowercased so it can't split one subsystem across two
+    rollup lines against fallback labels); the op-name patterns are
+    the fallback classifier. Collective patterns come FIRST — they
+    embed 'gather'/'scatter' as substrings, and communication being
+    misfiled under memory ops would invert the matmul-vs-comms
+    conclusion this rollup exists to draw."""
+    cat = row.get("Category")
+    if cat:
+        return str(cat).lower()
+    name = str(row.get("Operation Name") or row.get("Operation")
+               or "").lower()
+    for pat, label in (("all-to-all", "collective"),
+                       ("all-reduce", "collective"),
+                       ("all-gather", "collective"),
+                       ("reduce-scatter", "collective"),
+                       ("collective", "collective"),
+                       ("permute", "collective"),
+                       ("dot", "matmul"), ("conv", "conv"),
+                       ("fusion", "fusion"), ("copy", "copy"),
+                       ("transpose", "transpose"),
+                       ("gather", "gather"), ("scatter", "scatter"),
+                       ("custom-call", "custom-call")):
+        if pat in name:
+            return label
+    return "other"

@@ -231,11 +231,48 @@ def main(argv: list[str] | None = None) -> int:
                 os.path.join(host_dir, "postmortem"),
                 telemetry=tel, abort=cfg.train.watchdog_abort)
 
+        # In-run profiler capture + attribution (telemetry/
+        # attribution.py): scheduled steps from train.profile_at plus
+        # the drop-a-file trigger (<run_dir>/profile_now) for
+        # already-running jobs. Coordinator-gated — the trace and the
+        # attribution event are process-local, and one host's
+        # timeline answers the fleet's question.
+        from distributed_training_tpu.telemetry.attribution import (
+            ProfileCapture)
+        profile_capture = ProfileCapture(
+            run_dir, at_steps=cfg.train.profile_at,
+            n_steps=cfg.train.profile_steps,
+            enabled=rt.is_coordinator)
+
+        # Live metrics endpoint (telemetry/metrics_server.py),
+        # coordinator-only: Prometheus exposition + /healthz off the
+        # same Telemetry sink that writes events.jsonl. The bound
+        # port is recorded in <run_dir>/metrics.port for tooling.
+        metrics_server = None
+        if cfg.train.metrics_port > 0 and rt.is_coordinator:
+            from distributed_training_tpu.telemetry.metrics_server \
+                import MetricsServer
+            ds = getattr(loader, "dataset", None)
+            tokens_per_sample = (getattr(ds, "seq_len", None)
+                                 or cfg.train.pack_seq_len or 1)
+            metrics_server = MetricsServer(
+                cfg.train.metrics_port, telemetry=tel,
+                tokens_per_step=loader.global_batch
+                * tokens_per_sample,
+                stall_timeout_s=cfg.train.watchdog_timeout_s,
+                info={"world_size": rt.process_count,
+                      "incarnation": restart_count}).start()
+            if metrics_server is not None:
+                with open(os.path.join(run_dir, "metrics.port"),
+                          "w", encoding="utf-8") as pf:
+                    pf.write(f"{metrics_server.port}\n")
+
         trainer = Trainer(cfg, rt, model, loader, checkpointer,
                           preemption_guard=guard,
                           eval_loader=eval_loader,
                           watchdog=watchdog,
-                          fault_injector=fault_injector)
+                          fault_injector=fault_injector,
+                          profile_capture=profile_capture)
         if (trainer.epochs_run > 0 or trainer.global_step > 0
                 or restart_count > 0):
             # Recovery evidence: which step this incarnation picked up
@@ -286,6 +323,9 @@ def main(argv: list[str] | None = None) -> int:
         finally:
             if watchdog is not None:
                 watchdog.stop()
+            if metrics_server is not None:
+                metrics_server.stop()
+            profile_capture.abort()  # run ended mid-capture window
             tel.close()
     if rt.is_coordinator:
         logger.info("training done: %s", summary)

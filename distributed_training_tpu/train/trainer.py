@@ -159,7 +159,8 @@ class Trainer:
     def __init__(self, cfg: Config, runtime: Runtime, model,
                  loader, checkpointer=None, preemption_guard=None,
                  eval_loader=None, abstract: bool = False,
-                 watchdog=None, fault_injector=None):
+                 watchdog=None, fault_injector=None,
+                 profile_capture=None):
         self.cfg = cfg
         self.rt = runtime
         self.model = model
@@ -185,6 +186,11 @@ class Trainer:
         # discipline as the straggler exchange, so injection can never
         # strand hosts on different sides of a collective. None → off.
         self.faults = fault_injector
+        # In-run profiler capture + step-time attribution (telemetry/
+        # attribution.py ProfileCapture, built by the CLI from
+        # train.profile_at / the run-dir drop-file trigger;
+        # coordinator-gated there). None → no capture, zero overhead.
+        self.profiles = profile_capture
         self.ledger = None
         self.hbm = None
         self._steps_dispatched = 0
@@ -619,6 +625,11 @@ class Trainer:
         with collectives.capture_stderr_fd() as cap:
             text = self._step_fn.lower(
                 abstract, batch, self.step_rng).compile().as_text()
+        # Stashed for the one-shot attribution_static event: the
+        # static overlap audit walks the SAME compiled text, so the
+        # two events can never describe different programs (and the
+        # compile is paid once).
+        self._last_audit_hlo = text
         rep = collectives.audit_hlo_text(text, mesh=self.rt.mesh)
         rep["mesh"] = {a: s for a, s in self.rt.spec.as_dict().items()
                        if s > 1}
@@ -652,8 +663,46 @@ class Trainer:
             except Exception:  # noqa: BLE001 — observability must not
                 # take down the training loop it observes.
                 logger.exception("collectives audit failed; continuing")
+                # The compile may have stashed its HLO text before the
+                # audit failed; without a consumer to clear it, the
+                # multi-MB dump would stay resident for the whole run.
+                self._last_audit_hlo = None
                 return
         self.telemetry.event("collectives", **rep)
+        self._emit_attribution_static()
+
+    def _emit_attribution_static(self) -> None:
+        """One-shot ``attribution_static`` event: the static overlap
+        score of the compiled schedule (telemetry/attribution.py),
+        from the HLO text the collectives audit just walked, with the
+        planner roofline's expected comms/compute seconds as the
+        denominator context — "the schedule hides X% of collectives,
+        which the cost model prices at Y ms/step"."""
+        text = getattr(self, "_last_audit_hlo", None)
+        # One-shot consumer: the compiled module's text dump can run
+        # tens of MB and must not stay resident for the whole run.
+        self._last_audit_hlo = None
+        if text is None:
+            return
+        from distributed_training_tpu.telemetry import attribution
+        try:
+            rep = attribution.overlap_summary(
+                attribution.hlo_overlap_report(text))
+        except Exception:  # noqa: BLE001 — same contract as the
+            # collectives audit: never take down the loop.
+            logger.exception("static overlap audit failed; continuing")
+            return
+        rep["step"] = self.global_step
+        if self.plan is not None:
+            score = (self.plan.provenance or {}).get("score", {})
+            for src, dst in (("comms_s", "expected_comms_s"),
+                             ("compute_s", "expected_compute_s")):
+                if isinstance(score.get(src), (int, float)):
+                    rep[dst] = score[src]
+            rep["sharding_plan"] = {
+                "name": self.plan.name,
+                "fingerprint": self.plan.fingerprint()}
+        self.telemetry.event("attribution_static", **rep)
 
     def _run_epoch(self, epoch: int) -> dict[str, float]:
         """Parity: Trainer._run_epoch (src/distributed_trainer.py:167-183)
@@ -678,6 +727,13 @@ class Trainer:
                         timeout_s=(self.watchdog.timeout_s * 10
                                    if self._steps_dispatched == 0
                                    else None))
+                if self.profiles is not None:
+                    # In-run trace capture (train.profile_at / the
+                    # drop-file trigger): started BEFORE the fetch so
+                    # the captured window includes the step's data
+                    # wait — the host+data fraction of the
+                    # attribution needs it on the timeline.
+                    self.profiles.maybe_start(self.global_step + 1)
                 # Host time blocked on the (prefetching) loader — the
                 # data_wait goodput bucket. Near-zero when prefetch keeps
                 # up; a hot data_wait is an input-pipeline limiter.
@@ -753,6 +809,18 @@ class Trainer:
                         **self.ledger.window_report())
                 if self.watchdog is not None:
                     self.watchdog.disarm()
+                if self.profiles is not None:
+                    # Close the capture window once its steps are in.
+                    # The sync drains the traced async dispatches so
+                    # their device work lands in the trace; it fires
+                    # only on a capture's FINAL step, after the step
+                    # span closed — the stall books to idle, never to
+                    # the goodput step bucket.
+                    rep = self.profiles.maybe_stop(
+                        self.global_step,
+                        sync=lambda: jax.block_until_ready(metrics))  # noqa: DTT003 — capture-final-step drain by design
+                    if rep is not None:
+                        self.telemetry.event("attribution", **rep)
                 losses.append(metrics["loss"])
                 if self.faults is not None:
                     # After the step's bookkeeping, before the stop poll:

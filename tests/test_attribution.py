@@ -1,0 +1,706 @@
+"""Step-time attribution subsystem (telemetry/xplane.py,
+attribution.py, metrics_server.py): XSpace encode/parse round trip,
+timeline attribution arithmetic pinned to exact fractions on
+synthesized device lanes, the host-fallback executor-window filter,
+static schedule-overlap scoring on hand-written HLO, the
+OVERLAP_baseline ratchet (pin-outranks-baseline included), in-run
+ProfileCapture (one-shot ledger, drop-file trigger), the live
+Prometheus endpoint + /healthz, and the CPU trainer end-to-end
+(`attribution` + `attribution_static` events). All tier-1-safe, zero
+devices beyond the faked CPU mesh."""
+
+import json
+import os
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from distributed_training_tpu import telemetry
+from distributed_training_tpu.analysis import baseline
+from distributed_training_tpu.config import Config
+from distributed_training_tpu.data import (ShardedDataLoader,
+                                           SyntheticRegressionDataset)
+from distributed_training_tpu.models import build_model
+from distributed_training_tpu.telemetry import attribution, xplane
+from distributed_training_tpu.telemetry.attribution import (
+    ProfileCapture, hlo_overlap_report, parse_profile_at)
+from distributed_training_tpu.telemetry.metrics_server import (
+    MetricsServer)
+from distributed_training_tpu.train.trainer import Trainer
+
+
+@pytest.fixture(autouse=True)
+def _fresh_ambient():
+    telemetry.uninstall()
+    yield
+    telemetry.uninstall()
+
+
+def _read_jsonl(path):
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+def _ev(name, start, dur):
+    return xplane.Event(name=name, start_ps=start, dur_ps=dur)
+
+
+# -- xplane wire format ----------------------------------------------------
+
+
+def test_xspace_encode_parse_round_trip():
+    planes = [xplane.Plane(name="/device:TPU:0", lanes=[
+        xplane.Lane(name="XLA Ops", events=[
+            _ev("fusion.1", 0, 10), _ev("all-gather.2", 5, 10)]),
+        xplane.Lane(name="Steps", events=[_ev("step 3", 0, 15)]),
+    ])]
+    back = xplane.parse_xspace(xplane.encode_xspace(planes))
+    assert len(back) == 1 and back[0].name == "/device:TPU:0"
+    assert [ln.name for ln in back[0].lanes] == ["XLA Ops", "Steps"]
+    evs = back[0].lanes[0].events
+    assert [(e.name, e.start_ps, e.dur_ps) for e in evs] == \
+        [("fusion.1", 0, 10), ("all-gather.2", 5, 10)]
+
+
+def test_parse_rejects_garbage():
+    with pytest.raises(xplane.XplaneError):
+        # wire type 7 does not exist
+        xplane.parse_xspace(bytes([0x0F, 0x01]))
+
+
+def test_load_xspace_converts_any_corruption_to_typed_error(
+        tmp_path):
+    """A truncated/corrupt trace must surface as XplaneError — the
+    runtime consumer catches exactly that type, and an arbitrary
+    parse exception would propagate into the step loop."""
+    good = xplane.encode_xspace([xplane.Plane(
+        name="/device:TPU:0", lanes=[xplane.Lane(
+            name="XLA Ops", events=[_ev("fusion.1", 0, 10)])])])
+    for i, blob in enumerate((good[:-3], b"\x00\x01junk",
+                              good + b"\x0f")):
+        p = tmp_path / f"bad{i}.xplane.pb"
+        p.write_bytes(blob)
+        with pytest.raises(xplane.XplaneError):
+            xplane.load_xspace(str(p))
+
+
+# -- attribution arithmetic ------------------------------------------------
+
+
+def test_attribution_exact_fractions_on_device_lane():
+    """Known intervals → exact expected fractions. One lane:
+    compute [0,10) + [20,25), collective [5,15). Window 25: compute
+    15/25, exposed collective 5/25, host 5/25, overlap 5/10."""
+    planes = [xplane.Plane(name="/device:TPU:0", lanes=[
+        xplane.Lane(name="XLA Ops", events=[
+            _ev("fusion.1", 0, 10),
+            _ev("all-gather-start.2", 5, 10),
+            _ev("fusion.3", 20, 5)])])]
+    rep = xplane.attribution_of_planes(planes)
+    assert rep["source"] == "device"
+    assert rep["compute_frac"] == 0.6
+    assert rep["collective_frac"] == 0.2
+    assert rep["host_frac"] == 0.2
+    assert rep["overlap_frac"] == 0.5
+    assert rep["compute_frac"] + rep["collective_frac"] \
+        + rep["host_frac"] == pytest.approx(1.0)
+
+
+def test_attribution_cross_lane_overlap_counts_once():
+    """A collective on its own lane fully under compute on another:
+    overlap 100%, zero exposed collective; concurrent compute on two
+    lanes is unioned, not summed."""
+    planes = [xplane.Plane(name="/device:TPU:0", lanes=[
+        xplane.Lane(name="XLA Ops", events=[
+            _ev("fusion.1", 0, 20), _ev("fusion.2", 10, 20)]),
+        xplane.Lane(name="XLA Ops", events=[
+            _ev("all-reduce.9", 5, 10)])])]
+    rep = xplane.attribution_of_planes(planes)
+    assert rep["overlap_frac"] == 1.0
+    assert rep["collective_frac"] == 0.0
+    assert rep["compute_frac"] == 1.0  # [0,30) covers the window
+    assert rep["host_frac"] == 0.0
+
+
+def test_attribution_device_plane_prefers_xla_ops_lane():
+    """With an "XLA Ops" lane present, coarser lanes ("Steps", "XLA
+    Modules") must not double-count the same wall-clock."""
+    planes = [xplane.Plane(name="/device:TPU:0", lanes=[
+        xplane.Lane(name="Steps", events=[_ev("step 1", 0, 100)]),
+        xplane.Lane(name="XLA Ops", events=[_ev("fusion.1", 0, 10)]),
+    ])]
+    rep = xplane.attribution_of_planes(planes)
+    assert rep["events"] == 1 and rep["compute_frac"] == 1.0
+
+
+def test_attribution_host_fallback_uses_executor_windows():
+    """CPU-platform shape: ops execute inline on the python lane
+    inside executor windows. Python frames ($-prefixed), telemetry
+    span annotations (straddle the window), and the executor records
+    themselves are all excluded as ops — but the step annotation
+    WIDENS the window, so the data wait before the first op counts
+    as host time instead of silently falling outside."""
+    planes = [xplane.Plane(name="/host:CPU", lanes=[
+        xplane.Lane(name="python", events=[
+            _ev("$builtins isinstance", 0, 30),
+            _ev("step", 0, 30),  # telemetry TraceAnnotation
+            _ev("TfrtCpuExecutable::Execute", 10, 10),
+            _ev("dot.1", 12, 4)]),
+        xplane.Lane(name="tf_XLAEigen/1", events=[
+            _ev("fusion.2", 14, 4)])])]
+    rep = xplane.attribution_of_planes(planes)
+    assert rep["source"] == "host"
+    assert rep["events"] == 2
+    # compute union [12,18) over the annotation window [0,30).
+    assert rep["compute_frac"] == 0.2 and rep["host_frac"] == 0.8
+
+
+def test_attribution_window_widened_by_step_annotation():
+    """An input-bound step (ops clustered at the end of a long
+    data_wait) must attribute the wait to host+data — without the
+    annotation widening, the window would clip to the ops alone and
+    report host_frac 0 on exactly the run attribution exists to
+    diagnose."""
+    u = 10 ** 7  # ps per fixture tick, so window_s survives rounding
+    planes = [xplane.Plane(name="/host:CPU", lanes=[
+        xplane.Lane(name="python", events=[
+            _ev("data_wait", 0, 80 * u),
+            _ev("step", 80 * u, 20 * u),
+            _ev("dot.1", 90 * u, 10 * u)])])]
+    rep = xplane.attribution_of_planes(planes)
+    assert rep["window_s"] == pytest.approx(100 * u * 1e-12)
+    assert rep["compute_frac"] == 0.1
+    assert rep["host_frac"] == 0.9
+    # Fixtures without annotations keep the op-extent window.
+    no_marker = [xplane.Plane(name="/host:CPU", lanes=[
+        xplane.Lane(name="w", events=[_ev("dot.1", 90 * u,
+                                          10 * u)])])]
+    assert xplane.attribution_of_planes(no_marker)["host_frac"] == 0.0
+
+
+def test_attribution_host_fallback_without_executor_windows():
+    """A vintage with no recognizable executor records keeps every
+    classifiable event (best-effort beats silence)."""
+    planes = [xplane.Plane(name="/host:CPU", lanes=[
+        xplane.Lane(name="worker", events=[_ev("dot.5", 0, 10)])])]
+    rep = xplane.attribution_of_planes(planes)
+    assert rep["events"] == 1 and rep["compute_frac"] == 1.0
+
+
+def test_attribution_empty_trace():
+    rep = xplane.attribution_of_planes(
+        [xplane.Plane(name="/host:CPU", lanes=[])])
+    assert rep["host_frac"] == 1.0 and rep["events"] == 0
+    assert rep["compute_frac"] + rep["collective_frac"] \
+        + rep["host_frac"] == pytest.approx(1.0)
+
+
+def test_classify_event():
+    assert xplane.classify_event("all-reduce.5") == "collective"
+    assert xplane.classify_event("reduce-scatter-start.1") == \
+        "collective"
+    assert xplane.classify_event("collective-permute.2") == \
+        "collective"
+    assert xplane.classify_event("reduce.8") == "compute"  # not AR
+    assert xplane.classify_event("fusion.3") == "compute"
+    assert xplane.classify_event("$abc.py:1 frame") is None
+    assert xplane.classify_event("ThreadpoolListener::Record") is None
+    assert xplane.classify_event("") is None
+
+
+# -- static schedule-overlap audit ----------------------------------------
+
+
+_ASYNC_SEPARATED = """HloModule t, is_scheduled=true
+
+ENTRY %main (p0: f32[8,8]) -> f32[8,8] {
+  %p0 = f32[8,8]{1,0} parameter(0)
+  %ag-start = f32[16,8]{1,0} all-gather-start(f32[8,8]{1,0} %p0), dimensions={0}
+  %dot.1 = f32[8,8]{1,0} dot(f32[8,8]{1,0} %p0, f32[8,8]{1,0} %p0)
+  %fusion.2 = f32[8,8]{1,0} fusion(f32[8,8]{1,0} %dot.1), kind=kLoop
+  %ag-done = f32[16,8]{1,0} all-gather-done(f32[16,8]{1,0} %ag-start)
+  ROOT %add = f32[8,8]{1,0} add(f32[8,8]{1,0} %fusion.2, f32[8,8]{1,0} %fusion.2)
+}
+"""
+
+_ASYNC_ADJACENT = """HloModule t, is_scheduled=true
+
+ENTRY %main (p0: f32[8,8]) -> f32[8,8] {
+  %p0 = f32[8,8]{1,0} parameter(0)
+  %dot.1 = f32[8,8]{1,0} dot(f32[8,8]{1,0} %p0, f32[8,8]{1,0} %p0)
+  %ag-start = f32[16,8]{1,0} all-gather-start(f32[8,8]{1,0} %p0), dimensions={0}
+  %ag-done = f32[16,8]{1,0} all-gather-done(f32[16,8]{1,0} %ag-start)
+  ROOT %add = f32[8,8]{1,0} add(f32[8,8]{1,0} %dot.1, f32[8,8]{1,0} %dot.1)
+}
+"""
+
+
+def test_overlap_async_pair_with_separation_scores_one():
+    rep = hlo_overlap_report(_ASYNC_SEPARATED)
+    assert rep["scored"] == 1 and rep["async_pairs"] == 1
+    assert rep["overlap_score"] == 1.0
+    assert rep["pairs"][0]["compute_between"] == 2
+    assert rep["pairs"][0]["kind"] == "all-gather"
+
+
+def test_overlap_async_pair_adjacent_scores_zero():
+    rep = hlo_overlap_report(_ASYNC_ADJACENT)
+    assert rep["scored"] == 1
+    assert rep["overlap_score"] == 0.0
+    assert rep["pairs"][0]["compute_between"] == 0
+
+
+def test_overlap_sync_form_scheduled_uses_first_consumer():
+    text = """HloModule t, is_scheduled=true
+
+ENTRY %main (p0: f32[8,8]) -> f32[8,8] {
+  %p0 = f32[8,8]{1,0} parameter(0)
+  %ag.9 = f32[16,8]{1,0} all-gather(f32[8,8]{1,0} %p0), dimensions={0}
+  %ag.90 = f32[16,8]{1,0} all-gather(f32[8,8]{1,0} %p0), dimensions={0}
+  %dot.1 = f32[8,8]{1,0} dot(f32[8,8]{1,0} %p0, f32[8,8]{1,0} %p0)
+  %use.90 = f32[16,8]{1,0} negate(f32[16,8]{1,0} %ag.90)
+  ROOT %use.9 = f32[16,8]{1,0} add(f32[16,8]{1,0} %ag.9, f32[16,8]{1,0} %ag.9)
+}
+"""
+    rep = hlo_overlap_report(text)
+    # %ag.9's first use is AFTER the dot (overlapped); %ag.90's gap
+    # holds the same dot — and the consumer match must be exact
+    # (%ag.9 must not match %ag.90's use).
+    assert rep["scored"] == 2
+    assert rep["overlap_score"] == 1.0
+
+
+def test_overlap_sync_form_unscheduled_not_scored():
+    text = _ASYNC_SEPARATED.replace(", is_scheduled=true", "")
+    text = text.replace("all-gather-start", "all-gather").replace(
+        "all-gather-done(f32[16,8]{1,0} %ag-start)",
+        "negate(f32[16,8]{1,0} %ag-start)")
+    rep = hlo_overlap_report(text)
+    assert rep["scored"] == 0 and rep["overlap_score"] is None
+    assert rep["unscored"] >= 1
+
+
+def test_overlap_tuple_typed_collectives_are_scored():
+    """Async starts and combiner-grouped all-reduces have TUPLE
+    result types with spaces — the instruction parser must not drop
+    them, or enabling async collectives would make them vanish from
+    the score instead of raising it."""
+    text = """HloModule t, is_scheduled=true
+
+ENTRY %main (p0: f32[8,8]) -> f32[8,8] {
+  %p0 = f32[8,8]{1,0} parameter(0)
+  %ags = (f32[8,8]{1,0}, f32[16,8]{1,0}) all-gather-start(f32[8,8]{1,0} %p0), dimensions={0}
+  %dot.1 = f32[8,8]{1,0} dot(f32[8,8]{1,0} %p0, f32[8,8]{1,0} %p0)
+  %agd = f32[16,8]{1,0} all-gather-done((f32[8,8]{1,0}, f32[16,8]{1,0}) %ags)
+  %car = (f32[8,8]{1,0}, f32[8,8]{1,0}) all-reduce(f32[8,8]{1,0} %dot.1, f32[8,8]{1,0} %p0)
+  %fusion.9 = f32[8,8]{1,0} fusion(f32[8,8]{1,0} %dot.1), kind=kLoop
+  ROOT %gte = f32[8,8]{1,0} get-tuple-element((f32[8,8]{1,0}, f32[8,8]{1,0}) %car), index=0
+}
+"""
+    rep = hlo_overlap_report(text)
+    assert rep["scored"] == 2, rep
+    kinds = sorted(p["kind"] for p in rep["pairs"])
+    assert kinds == ["all-gather", "all-reduce"]
+    assert rep["overlap_score"] == 1.0  # dot / fusion in both gaps
+
+
+def test_overlap_nested_tuple_async_start_is_scored():
+    """A combiner-grouped async start over 2 operands has a
+    tuple-of-tuples result type — still one scored collective."""
+    tt = "((f32[8]{0}, f32[8]{0}), (f32[16]{0}, f32[16]{0}))"
+    text = f"""HloModule t, is_scheduled=true
+
+ENTRY %main (p0: f32[8]) -> f32[8] {{
+  %p0 = f32[8]{{0}} parameter(0)
+  %ags = {tt} all-gather-start(f32[8]{{0}} %p0, f32[8]{{0}} %p0)
+  %dot.1 = f32[8]{{0}} dot(f32[8]{{0}} %p0, f32[8]{{0}} %p0)
+  ROOT %agd = (f32[16]{{0}}, f32[16]{{0}}) all-gather-done({tt} %ags)
+}}
+"""
+    rep = hlo_overlap_report(text)
+    assert rep["scored"] == 1 and rep["async_pairs"] == 1
+    assert rep["overlap_score"] == 1.0
+
+
+def test_overlap_fused_rs_is_not_compute_in_anothers_gap():
+    """Two back-to-back fused reduce-scatters must not score each
+    other as hidden compute — a pure-comms gap is exposed."""
+    text = """HloModule t, is_scheduled=true
+
+ENTRY %main (p0: f32[8,8]) -> f32[8,8] {
+  %p0 = f32[8,8]{1,0} parameter(0)
+  %rs.1 = f32[1,8]{1,0} fusion(f32[8,8]{1,0} %p0), kind=kCustom, calls=%all-reduce-scatter.2
+  %rs.3 = f32[1,8]{1,0} fusion(f32[8,8]{1,0} %p0), kind=kCustom, calls=%all-reduce-scatter.4
+  %use.1 = f32[1,8]{1,0} negate(f32[1,8]{1,0} %rs.1)
+  ROOT %use.3 = f32[1,8]{1,0} add(f32[1,8]{1,0} %rs.3, f32[1,8]{1,0} %rs.3)
+}
+"""  # noqa: E501 — verbatim HLO line shapes
+    rep = hlo_overlap_report(text)
+    assert rep["scored"] == 2
+    assert rep["overlap_score"] == 0.0, rep["pairs"]
+
+
+def test_overlap_fused_reduce_scatter_counts_as_collective():
+    text = """HloModule t, is_scheduled=true
+
+ENTRY %main (p0: f32[8,8]) -> f32[8,8] {
+  %p0 = f32[8,8]{1,0} parameter(0)
+  %rs.1 = f32[1,8]{1,0} fusion(f32[8,8]{1,0} %p0), kind=kCustom, calls=%all-reduce-scatter.2
+  %dot.1 = f32[8,8]{1,0} dot(f32[8,8]{1,0} %p0, f32[8,8]{1,0} %p0)
+  ROOT %use = f32[1,8]{1,0} negate(f32[1,8]{1,0} %rs.1)
+}
+"""
+    rep = hlo_overlap_report(text)
+    assert rep["scored"] == 1
+    assert rep["pairs"][0]["kind"] == "reduce-scatter"
+    assert rep["overlap_score"] == 1.0
+
+
+# -- OVERLAP_baseline ratchet ---------------------------------------------
+
+
+def _doc(score, scored=10, target="t1"):
+    return {"targets": [{"target": target,
+                         "overlap": {"overlap_score": score,
+                                     "scored": scored}}]}
+
+
+def test_overlap_ratchet_pass_and_regress(tmp_path):
+    path = str(tmp_path / "OVERLAP_baseline.json")
+    baseline.write_overlap(_doc(0.3), path=path)
+    base = baseline.load_overlap(path)
+    assert base["targets"]["t1"]["overlap_score"] == 0.3
+    # same and better pass; worse fails; evidence vanishing fails.
+    assert baseline.compare_overlap(_doc(0.3), base) == []
+    assert baseline.compare_overlap(_doc(0.4), base) == []
+    assert baseline.compare_overlap(_doc(0.2), base)
+    assert baseline.compare_overlap(_doc(None, scored=0), base)
+
+
+def test_overlap_ratchet_ungated_until_baselined():
+    empty = {"schema": 1, "targets": {}}
+    assert baseline.compare_overlap(_doc(0.01), empty) == []
+
+
+def test_overlap_pin_outranks_baseline(tmp_path):
+    """A min_overlap pin fails a low score even when the committed
+    baseline was (wrongly) rewritten below it, and --write-baseline
+    refuses to freeze a sub-pin score at all."""
+    path = str(tmp_path / "OVERLAP_baseline.json")
+    # Baseline laundered down to 0.1: the ratchet alone would pass...
+    baseline.write_overlap(_doc(0.1), path=path)
+    base = baseline.load_overlap(path)
+    assert baseline.compare_overlap(_doc(0.1), base) == []
+    # ...but the pin still fails it.
+    problems = baseline.compare_overlap(_doc(0.1), base,
+                                        min_overlap={"t1": 0.25})
+    assert problems and "min_overlap pin" in problems[0]
+    with pytest.raises(ValueError):
+        baseline.write_overlap(_doc(0.1), path=path,
+                               min_overlap={"t1": 0.25})
+
+
+def test_committed_overlap_baseline_matches_targets():
+    """The committed OVERLAP_baseline.json covers every audit target
+    with a min_overlap pin, at or above the pin — the gate's
+    pin/baseline pair must be self-consistent as committed."""
+    from distributed_training_tpu.analysis import targets
+    doc = baseline.load_overlap()
+    for t in targets.TARGETS.values():
+        if t.min_overlap is None:
+            continue
+        row = doc["targets"].get(t.name)
+        assert row is not None, f"{t.name} pinned but not baselined"
+        assert row["overlap_score"] >= t.min_overlap
+
+
+# -- ProfileCapture --------------------------------------------------------
+
+
+def test_parse_profile_at():
+    assert parse_profile_at("") == ()
+    assert parse_profile_at("20") == (20,)
+    assert parse_profile_at("500,20,20") == (20, 500)
+    with pytest.raises(ValueError):
+        parse_profile_at("20,x")
+
+
+def test_profile_capture_scheduled_one_shot(tmp_path):
+    """A scheduled capture fires once, attributes a real trace, and
+    stays fired across a 'restart' (a fresh instance over the same
+    run dir — the faults-ledger discipline)."""
+    import jax
+    import jax.numpy as jnp
+
+    run_dir = str(tmp_path)
+    pc = ProfileCapture(run_dir, at_steps="3", n_steps=1)
+    assert not pc.maybe_start(1)
+    assert pc.maybe_start(3)
+    f = jax.jit(lambda x: (x @ x).sum())
+    f(jnp.ones((64, 64))).block_until_ready()
+    rep = pc.maybe_stop(3, sync=lambda: None)
+    assert rep is not None and "error" not in rep
+    assert rep["steps_captured"] == 1
+    assert rep["compute_frac"] + rep["collective_frac"] \
+        + rep["host_frac"] == pytest.approx(1.0, abs=1e-4)
+    assert os.path.isdir(os.path.join(run_dir, rep["trace_dir"]))
+    # restart: same dir, same schedule → already fired.
+    pc2 = ProfileCapture(run_dir, at_steps="3", n_steps=1)
+    assert not pc2.maybe_start(3)
+    assert not pc2.maybe_start(10)  # at-or-after, still one-shot
+
+
+def test_profile_capture_one_capture_satisfies_all_stale_triggers(
+        tmp_path):
+    """A resume landing past several profile_at steps runs ONE
+    capture, not one per stale entry back-to-back."""
+    import jax
+    import jax.numpy as jnp
+
+    pc = ProfileCapture(str(tmp_path), at_steps="20,500", n_steps=1)
+    assert pc.maybe_start(600)
+    jax.jit(lambda x: x + 1)(jnp.ones((4,))).block_until_ready()
+    rep = pc.maybe_stop(600, sync=lambda: None)
+    assert rep is not None and rep["trigger"] == "step_20"
+    assert not pc.maybe_start(601)  # step_500 satisfied by the same
+    # ...and the satisfaction is persisted across a restart.
+    pc2 = ProfileCapture(str(tmp_path), at_steps="20,500", n_steps=1)
+    assert not pc2.maybe_start(602)
+
+
+def test_profile_capture_drop_file_trigger(tmp_path):
+    import jax
+    import jax.numpy as jnp
+
+    run_dir = str(tmp_path)
+    pc = ProfileCapture(run_dir, at_steps=(), n_steps=1)
+    assert not pc.maybe_start(5)  # nothing scheduled, no file
+    trigger = os.path.join(run_dir, "profile_now")
+    with open(trigger, "w"):
+        pass
+    assert pc.maybe_start(6)
+    assert not os.path.exists(trigger)  # consumed
+    jax.jit(lambda x: x * 2)(jnp.ones((8,))).block_until_ready()
+    rep = pc.maybe_stop(6, sync=lambda: None)
+    assert rep is not None and rep["trigger"] == "file_at_6"
+
+
+def test_profile_capture_disabled_never_fires(tmp_path):
+    pc = ProfileCapture(str(tmp_path), at_steps="1", enabled=False)
+    assert not pc.maybe_start(1)
+    assert pc.maybe_stop(1) is None
+
+
+# -- metrics endpoint ------------------------------------------------------
+
+
+def _get(port, path):
+    return urllib.request.urlopen(
+        f"http://127.0.0.1:{port}{path}", timeout=5)
+
+
+def test_metrics_server_exposition_and_healthz(tmp_path):
+    tel = telemetry.Telemetry(
+        events_jsonl=str(tmp_path / "events.jsonl"))
+    srv = MetricsServer(0, telemetry=tel, tokens_per_step=1024,
+                        stall_timeout_s=0.4,
+                        info={"world_size": 4,
+                              "incarnation": 0}).start()
+    assert srv is not None and srv.port
+    try:
+        with tel.span("step", step=1):
+            time.sleep(0.01)
+        with tel.span("data_wait", step=2):
+            pass
+        tel.event("goodput", scope="window", step=2, mfu_wall=0.31,
+                  goodput=0.8, buckets={})
+        tel.event("attribution", step=3, overlap_frac=0.42,
+                  compute_frac=0.5, collective_frac=0.2,
+                  host_frac=0.3)
+        tel.event("attribution_static", step=1, overlap_score=0.32,
+                  scored=63)
+        tel.event("straggler", step=100, persistent=["host 3 slow"])
+        tel.event("resume", step=5, world_size=3, restarts=2)
+        body = _get(srv.port, "/metrics").read().decode()
+        # The acceptance surface: every advertised metric name.
+        for want in ("dtt_mfu 0.31", "dtt_tokens_per_s",
+                     "dtt_goodput 0.8", "dtt_data_wait_seconds_total",
+                     "dtt_overlap_fraction 0.42",
+                     "dtt_overlap_static_fraction 0.32",
+                     "dtt_world_size 3", "dtt_incarnation 2",
+                     "dtt_straggler_verdicts_total 1",
+                     "dtt_step_time_seconds", "dtt_steps_total 1",
+                     "dtt_up 1"):
+            assert want in body, (want, body)
+        # Valid Prometheus text exposition: every sample line's metric
+        # has HELP + TYPE, values parse as floats.
+        names = set()
+        for line in body.strip().splitlines():
+            if line.startswith("# "):
+                continue
+            name, val = line.split(" ", 1)
+            float(val)
+            names.add(name)
+        for n in names:
+            assert f"# TYPE {n} " in body
+        # healthz: ok while fresh, 503 once stalled past threshold.
+        assert _get(srv.port, "/healthz").status == 200
+        time.sleep(0.6)
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(srv.port, "/healthz")
+        assert ei.value.code == 503
+        assert json.loads(ei.value.read())["status"] == "stalled"
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(srv.port, "/nope")
+        assert ei.value.code == 404
+    finally:
+        srv.stop()
+        tel.close()
+
+
+def test_metrics_server_healthz_compile_allowance():
+    """Before the first step the stall budget is 10x (the watchdog's
+    compile allowance) — a compiling run is 'starting', not dead."""
+    srv = MetricsServer(0, stall_timeout_s=5.0)
+    healthy, detail = srv.health()
+    assert healthy and detail["status"] == "starting"
+    # The FIRST optimizer step dispatches under a "compile" span:
+    # it must count as a step and flip the latch to the 1x budget.
+    srv.observe({"kind": "span", "name": "compile", "dur_s": 2.0})
+    healthy, detail = srv.health()
+    assert healthy and detail["status"] == "ok"
+    assert detail["steps"] == 1
+
+
+def test_metrics_server_observer_failure_does_not_break_sink(
+        tmp_path):
+    """A broken observer must not disturb emission (the endpoint is a
+    consumer of the stream, never a gate on it)."""
+    path = str(tmp_path / "events.jsonl")
+    tel = telemetry.Telemetry(events_jsonl=path)
+    tel.add_observer(lambda rec: (_ for _ in ()).throw(
+        RuntimeError("observer boom")))
+    tel.event("goodput", scope="window", step=1)
+    tel.close()
+    assert [e for e in _read_jsonl(path) if e["kind"] == "goodput"]
+
+
+# -- trainer end-to-end ----------------------------------------------------
+
+
+def _demo(rt, tmp_path, **train_over):
+    cfg = Config()
+    cfg.train.batch_size = 4
+    cfg.train.total_epochs = 3
+    cfg.train.save_every = 0
+    cfg.train.log_every = 1
+    cfg.train.dataset_size = 32
+    cfg.train.metrics_jsonl = str(tmp_path / "run" / "metrics.jsonl")
+    cfg.train.events_jsonl = str(tmp_path / "run" / "events.jsonl")
+    for k, v in train_over.items():
+        setattr(cfg.train, k, v)
+    model = build_model("mlp", input_size=20, output_size=1,
+                        loss="mse")
+    ds = SyntheticRegressionDataset(size=32, in_dim=20, out_dim=1,
+                                    seed=0)
+    loader = ShardedDataLoader(ds, rt, batch_size=4)
+    return cfg, model, loader
+
+
+def test_trainer_emits_attribution_events(cpu8, tmp_path):
+    """The acceptance path: a CPU run with a profile trigger produces
+    an `attribution` event whose fractions sum to ~1.0 with an
+    overlap %, plus the one-shot `attribution_static` after first
+    compile — and the summarizer renders both."""
+    cfg, model, loader = _demo(cpu8, tmp_path)
+    telemetry.install(telemetry.Telemetry(
+        events_jsonl=cfg.train.events_jsonl))
+    run_dir = str(tmp_path / "run")
+    pc = ProfileCapture(run_dir, at_steps="2", n_steps=1)
+    trainer = Trainer(cfg, cpu8, model, loader, profile_capture=pc)
+    summary = trainer.train()
+    assert np.isfinite(summary["mean_loss"])
+    events = _read_jsonl(cfg.train.events_jsonl)
+
+    att = [e for e in events if e["kind"] == "attribution"]
+    assert len(att) == 1, att
+    a = att[0]
+    assert "error" not in a
+    assert a["schema"] == attribution.SCHEMA
+    assert a["compute_frac"] + a["collective_frac"] + a["host_frac"] \
+        == pytest.approx(1.0, abs=1e-4)
+    assert 0.0 <= a["overlap_frac"] <= 1.0
+    assert a["events"] > 0
+
+    static = [e for e in events if e["kind"] == "attribution_static"]
+    assert len(static) == 1
+    assert static[0]["schema"] == attribution.OVERLAP_SCHEMA
+    assert "overlap_score" in static[0]
+
+    from distributed_training_tpu.telemetry.summarize import (
+        render, summarize_run)
+    summary_doc = summarize_run(run_dir)
+    assert summary_doc["attribution"]["overlap_frac"] == \
+        a["overlap_frac"]
+    assert "attribution (step" in render(summary_doc)
+
+
+def test_trainer_attribution_failure_does_not_kill_run(
+        cpu8, tmp_path, monkeypatch):
+    """A broken trace parse degrades to an `attribution` event with
+    an error field; the run finishes (the collectives-audit
+    contract)."""
+    cfg, model, loader = _demo(cpu8, tmp_path)
+    telemetry.install(telemetry.Telemetry(
+        events_jsonl=cfg.train.events_jsonl))
+    monkeypatch.setattr(
+        attribution, "attribute_trace_dir",
+        lambda d: (_ for _ in ()).throw(
+            xplane.XplaneError("parse boom")))
+    pc = ProfileCapture(str(tmp_path / "run"), at_steps="2",
+                        n_steps=1)
+    trainer = Trainer(cfg, cpu8, model, loader, profile_capture=pc)
+    summary = trainer.train()
+    assert np.isfinite(summary["mean_loss"])
+    att = [e for e in _read_jsonl(cfg.train.events_jsonl)
+           if e["kind"] == "attribution"]
+    assert len(att) == 1 and "parse boom" in att[0]["error"]
+
+
+# -- multi-host aggregate (additive keys, schema pinned) -------------------
+
+
+def test_aggregate_carries_attribution_schema_stays_1(tmp_path):
+    from distributed_training_tpu.telemetry import aggregate
+    run = tmp_path / "run"
+    for h in (0, 1):
+        d = run / f"host_{h}"
+        d.mkdir(parents=True)
+        with open(d / "events.jsonl", "w") as f:
+            f.write(json.dumps({"kind": "run_start", "t": 0.0,
+                                "step": 0, "host": h}) + "\n")
+            f.write(json.dumps({"kind": "clock_sync", "t": 0.1,
+                                "t_sync": 100.0, "process_index": h,
+                                "process_count": 2,
+                                "host": h}) + "\n")
+            if h == 0:
+                f.write(json.dumps(
+                    {"kind": "attribution", "t": 1.0, "host": 0,
+                     "step": 4, "overlap_frac": 0.4,
+                     "compute_frac": 0.5, "collective_frac": 0.1,
+                     "host_frac": 0.4, "source": "device"}) + "\n")
+                f.write(json.dumps(
+                    {"kind": "attribution_static", "t": 1.1,
+                     "host": 0, "step": 1, "overlap_score": 0.32,
+                     "scored": 63, "overlapped": 20,
+                     "mean_compute_between": 3.0}) + "\n")
+    summary = aggregate.aggregate_run(str(run))
+    assert summary["schema"] == 1  # additive keys only
+    assert summary["attribution"]["overlap_frac"] == 0.4
+    assert summary["attribution_static"]["overlap_score"] == 0.32
+    text = aggregate.render_multihost(summary)
+    assert "attribution (step" in text
+    assert "static overlap" in text
