@@ -47,13 +47,15 @@ SINGLE_CHIP_HEADLINE = {
 }
 
 
-def bench(plan_name: str, steps: int, warmup: int = 3) -> dict:
+def bench(plan_name: str, steps: int, warmup: int = 3,
+          overlap_flags: bool = True) -> dict:
     import jax
 
     from distributed_training_tpu.config import Config
     from distributed_training_tpu.data import (ShardedDataLoader,
                                                SyntheticLMDataset)
     from distributed_training_tpu.models import build_model
+    from distributed_training_tpu.parallel import overlap as overlap_lib
     from distributed_training_tpu.parallel import planner
     from distributed_training_tpu.runtime import fake_cpu_runtime
     from distributed_training_tpu.train.trainer import Trainer
@@ -148,6 +150,21 @@ def bench(plan_name: str, steps: int, warmup: int = 3) -> dict:
         "loss_last": round(loss_last, 4),
         "spmd_reshard_warnings": coll["spmd_reshard_warnings"],
         "collective_bytes_per_step": coll["bytes_per_step"],
+        # Scheduler/overlap provenance (docs/performance.md): the
+        # flags THIS measurement ran under, so r06-vs-r07 style
+        # comparisons are attributable to the schedule, not folklore.
+        "xla_overlap_flags": {
+            "enabled": overlap_flags,
+            "derived": plan.xla_overlap_flags(rt.platform),
+            "active": overlap_lib.active_in_env(
+                plan.xla_overlap_flags(rt.platform)),
+            "xla_flags_env": os.environ.get("XLA_FLAGS", ""),
+        },
+        # Which cost model scored the plan (measured calibration
+        # table vs nominal constants) — parallel/planner.py
+        # provenance, embedded so the ledger entry stands alone.
+        "calibration": plan.provenance.get(
+            "calibration", {"source": "nominal", "fingerprint": None}),
         "plan": {
             "name": plan.name,
             "fingerprint": plan.fingerprint(),
@@ -173,25 +190,55 @@ def main(argv=None) -> int:
     ap.add_argument("--out", default=None, metavar="PATH",
                     help="write the ledger entry here "
                          "(default: stdout only)")
+    ap.add_argument("--no-overlap-flags", action="store_true",
+                    help="measure WITHOUT the plan-derived XLA "
+                         "latency-hiding flags (reproduces the "
+                         "pre-r07 unscheduled behavior)")
+    ap.add_argument("--compare", default=None, metavar="ENTRY",
+                    help="embed a comparison block against an "
+                         "existing ledger entry (e.g. "
+                         "MULTICHIP_r06.json)")
     args = ap.parse_args(argv)
 
     # Device-less-friendly defaults: CPU backend with enough fake
     # devices for the plan, forced before the first backend init
     # (a real-TPU run sets JAX_PLATFORMS=tpu explicitly).
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from distributed_training_tpu.parallel import overlap, planner
+    plan = planner.load_plan(args.plan)
     if os.environ.get("JAX_PLATFORMS") == "cpu":
-        from distributed_training_tpu.parallel import planner
-        devices = planner.load_plan(args.plan).devices
         flags = os.environ.get("XLA_FLAGS", "")
         if "xla_force_host_platform_device_count" not in flags:
             os.environ["XLA_FLAGS"] = (
                 flags + " --xla_force_host_platform_device_count"
-                f"={devices}").strip()
+                f"={plan.devices}").strip()
+    if not args.no_overlap_flags:
+        # Scheduled comms/compute overlap: must land in XLA_FLAGS
+        # before the first backend init so the trainer's implicit
+        # step compile runs the latency-hiding schedule.
+        applied = overlap.apply_to_env(
+            plan.xla_overlap_flags(overlap.platform_from_env("cpu")))
+        if applied:
+            print(f"[bench_multichip] overlap flags: {applied}",
+                  file=sys.stderr)
     import jax
     if os.environ.get("JAX_PLATFORMS") == "cpu":
         jax.config.update("jax_platforms", "cpu")
 
-    entry = bench(args.plan, steps=args.steps, warmup=args.warmup)
+    entry = bench(args.plan, steps=args.steps, warmup=args.warmup,
+                  overlap_flags=not args.no_overlap_flags)
+    if args.compare:
+        with open(args.compare, encoding="utf-8") as f:
+            ref = json.load(f)
+        entry["compared_to"] = {
+            "entry": os.path.basename(args.compare),
+            "step_time_ms": ref.get("step_time_ms"),
+            "tokens_per_sec": ref.get("tokens_per_sec"),
+            "mesh": ref.get("mesh"),
+            "step_time_speedup": (
+                round(ref["step_time_ms"] / entry["step_time_ms"], 4)
+                if ref.get("step_time_ms") else None),
+        }
     text = json.dumps(entry, indent=1, sort_keys=True) + "\n"
     sys.stdout.write(text)
     if entry["spmd_reshard_warnings"]:

@@ -71,6 +71,12 @@ def main(argv=None) -> int:
     ap.add_argument("--write-baseline", action="store_true",
                     help="freeze current audit findings as the new "
                          "baseline")
+    ap.add_argument("--lower-overlap-floor", action="store_true",
+                    help="with --write-baseline: allow writing an "
+                         "overlap floor BELOW the committed one (an "
+                         "intentional schedule trade-off); refused "
+                         "by default, and a min_overlap pin still "
+                         "outranks this flag")
     ap.add_argument("--min-replicated-mib", type=float, default=1.0,
                     help="SPMD003 size floor in MiB (default 1)")
     ap.add_argument("--no-audit", action="store_true",
@@ -116,14 +122,17 @@ def main(argv=None) -> int:
             try:
                 opath = baseline.write_overlap(
                     doc, path=args.overlap_baseline,
-                    min_overlap=overlap_pins)
+                    min_overlap=overlap_pins,
+                    allow_lower=args.lower_overlap_floor)
                 print(f"[analysis] overlap baseline written: {opath}")
             except ValueError as e:
-                # Pin outranks --write-baseline: a destroyed schedule
-                # cannot become the new floor. A refused write is a
-                # failed REQUESTED action — nonzero even without
-                # --check (unlike report-only findings), or a regen
-                # script would proceed on a stale floor.
+                # Pin outranks --write-baseline, and a raised floor
+                # outranks a routine regen: neither a destroyed
+                # schedule nor a quiet regression can become the new
+                # floor. A refused write is a failed REQUESTED action
+                # — nonzero even without --check (unlike report-only
+                # findings), or a regen script would proceed on a
+                # stale floor.
                 print(f"[analysis] OVERLAP baseline NOT written: {e}")
                 rc = 1
                 write_failed = True

@@ -153,10 +153,16 @@ def audit_target(target, min_replicated_bytes: int = 1 << 20) -> dict:
         dict(target.model_kwargs), target.batch_size, target.seq_len,
         mesh_axes=dict(target.mesh_axes),
         train_overrides=dict(target.train_overrides))
+    # Per-target compiler options (the planned target's overlap
+    # flags): the audited schedule must be the one the flagged
+    # consumers execute, or the overlap ratchet scores a program
+    # nobody runs.
+    opts = dict(target.compiler_options) or None
     with collectives.capture_stderr_fd() as cap:
         text = trainer._step_fn.lower(
             trainer.state, batch,
-            jnp.zeros((2,), jnp.uint32)).compile().as_text()
+            jnp.zeros((2,), jnp.uint32)).compile(
+                compiler_options=opts).as_text()
     warnings = collectives.parse_reshard_warnings(cap.text)
     coll = collectives.audit_hlo_text(text, mesh=rt.mesh)
     coll["mesh"] = {a: s for a, s in rt.spec.as_dict().items()
@@ -186,6 +192,10 @@ def audit_target(target, min_replicated_bytes: int = 1 << 20) -> dict:
         # the gate (__main__.py). Additive key; SCHEMA stays 1.
         "overlap": attribution.overlap_summary(
             attribution.hlo_overlap_report(text)),
+        # Which per-compile options the schedule was audited under
+        # (the planned target's plan-derived overlap flags) — so a
+        # baseline score is attributable to its scheduler config.
+        "compiler_options": dict(target.compiler_options),
     }
 
 

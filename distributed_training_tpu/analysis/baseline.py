@@ -166,11 +166,20 @@ def compare_overlap(audit_doc: dict, baseline_doc: dict,
 
 
 def write_overlap(audit_doc: dict, path: str | None = None,
-                  min_overlap: dict[str, float] | None = None) -> str:
+                  min_overlap: dict[str, float] | None = None,
+                  allow_lower: bool = False) -> str:
     """Freeze current per-target overlap scores as the new floor.
-    Refuses to freeze a score below a target's ``min_overlap`` pin —
-    --write-baseline must not launder a destroyed schedule."""
+
+    Refuses to freeze a score below a target's ``min_overlap`` pin
+    (--write-baseline must not launder a destroyed schedule), and —
+    unless ``allow_lower`` — refuses to LOWER a previously raised
+    floor: the ratchet only tightens by default, so a regression
+    can't ride a routine baseline regen into the committed file. An
+    intentional slackening (a known schedule trade-off) passes
+    ``allow_lower`` explicitly (CLI: ``--lower-overlap-floor``) and
+    still cannot cross a pin."""
     min_overlap = min_overlap or {}
+    prior = load_overlap(path).get("targets", {})
     targets: dict[str, dict] = {}
     for name, ov in _overlap_rows(audit_doc).items():
         cur = ov.get("overlap_score")
@@ -180,8 +189,30 @@ def write_overlap(audit_doc: dict, path: str | None = None,
                 f"refusing to baseline {name} at overlap score "
                 f"{'none' if cur is None else f'{cur:.3f}'}: below "
                 f"its min_overlap pin {pin:.3f}")
+        floor = prior.get(name, {}).get("overlap_score")
+        if (not allow_lower and floor is not None
+                and (cur is None or cur < floor)):
+            raise ValueError(
+                f"refusing to LOWER {name}'s overlap floor from "
+                f"{floor:.3f} to "
+                f"{'none' if cur is None else f'{cur:.3f}'}: the "
+                "ratchet only tightens — pass --lower-overlap-floor "
+                "for an intentional slackening")
         targets[name] = {"overlap_score": cur,
                          "scored": ov.get("scored", 0)}
+    if not allow_lower:
+        # A target VANISHING from the audit (plan file absent mid-
+        # replan, target deregistered) must not silently erase its
+        # raised floor — dropping a baselined row is a lowering too.
+        dropped = [n for n, row in prior.items()
+                   if n not in targets
+                   and row.get("overlap_score") is not None]
+        if dropped:
+            raise ValueError(
+                f"refusing to DROP baselined overlap floor(s) for "
+                f"{sorted(dropped)}: the target(s) were not audited "
+                "this run — audit them, or pass "
+                "--lower-overlap-floor to remove them deliberately")
     path = path or OVERLAP_PATH
     doc = {
         "schema": OVERLAP_SCHEMA,

@@ -62,6 +62,13 @@ class AuditTarget:
     # pin-outranks-baseline rule, overlap edition. None = ratchet
     # against the committed baseline only.
     min_overlap: float | None = None
+    # Per-compile XLA options (jax ``compile(compiler_options=...)``)
+    # this target's program is audited under. The planned target
+    # carries its plan's overlap flags (``parallel/overlap.py``) so
+    # the ratchet scores the latency-hiding schedule the training
+    # consumers (cli/launch/bench) actually run — the audit and the
+    # run must compile the same program.
+    compiler_options: dict = field(default_factory=dict)
     note: str = ""
 
 
@@ -118,12 +125,21 @@ _register(AuditTarget(
 ))
 
 
+def _overlap_options(plan_doc: dict) -> dict:
+    """The plan's overlap flags as per-compile options for the (CPU)
+    audit backend — ``parallel/overlap.py``'s derivation over the raw
+    plan JSON, matching what cli/launch/bench apply via XLA_FLAGS."""
+    from distributed_training_tpu.parallel import overlap
+    return overlap.flags_for_plan_doc(plan_doc, "cpu")
+
+
 def _register_planned_target() -> None:
     """The committed plan as an audit target: read the raw plan JSON
-    (stdlib only — no planner/jax import at module import time) and
-    pin its exact configuration. Skipped silently if the plan file is
-    absent (a fresh checkout mid-replan); the planner --check gate
-    fails loudly in that case."""
+    (no planner import — the plan doc is consumed as data) and pin
+    its exact configuration, including the overlap compiler options
+    the plan derives. Skipped silently if the plan file is absent (a
+    fresh checkout mid-replan); the planner --check gate fails loudly
+    in that case."""
     path = os.path.join(
         os.path.dirname(os.path.dirname(os.path.dirname(
             os.path.abspath(__file__)))), "conf", "plans",
@@ -161,11 +177,16 @@ def _register_planned_target() -> None:
             dtype=mk.get("dtype", "float32"),
             optimizer=plan["inputs"]["optimizer"]),
         pin_zero=("SPMD001",),
-        # Floor under the measured 0.32 (CPU-partitioner schedule,
-        # 63 collectives scored): a plan/model change that destroys
-        # overlap scheduling fails even through --write-baseline.
-        # The ratchet (OVERLAP_baseline.json) holds the exact score.
-        min_overlap=0.25,
+        # Floor under the measured 0.92 (CPU backend with the plan's
+        # latency-hiding flags — the concurrency-optimized scheduler
+        # lifted this target from 0.32 unscheduled): a plan/model/
+        # flag change that destroys overlap scheduling fails even
+        # through --write-baseline. The ratchet
+        # (OVERLAP_baseline.json) holds the exact score.
+        min_overlap=0.85,
+        # The audit compiles the same scheduled program the flagged
+        # consumers run (module field docs).
+        compiler_options=_overlap_options(plan),
         note="The committed auto-parallelism plan (conf/plans/) "
              "compiled through the trainer's PlannedStrategy path — "
              "the configuration benchmarks/bench_multichip.py "

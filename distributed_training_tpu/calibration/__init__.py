@@ -1,0 +1,24 @@
+"""Measured hardware calibration for the planner's cost model.
+
+ROADMAP item 3(a): the planner's roofline used one nominal ICI
+bandwidth and a spec-sheet peak for every ``device_kind``. This
+package replaces "nominal" with "measured where we have measurements":
+``benchmarks/calibrate.py`` micro-benchmarks the collectives the cost
+model prices (all-gather, reduce-scatter, all-reduce, ppermute across
+message sizes) and matmul shapes on the CURRENT backend, fits
+piecewise latency/bandwidth and achievable-FLOPs curves, and commits
+them as a fingerprinted ``conf/calibration/<chip>.json``. The planner
+(``parallel/planner.py``) consumes the committed table when one
+matches the target chip and falls back to per-kind nominal constants
+otherwise — with the decision (and the table's fingerprint) recorded
+in plan provenance so ``planner --check`` catches drift.
+
+``table``: the stdlib-only artifact layer (schema, fingerprint,
+interpolation, chip-slug lookup) — importable by gates and launchers
+that must never touch jax. ``microbench``: the jax measurement layer.
+"""
+
+from distributed_training_tpu.calibration.table import (  # noqa: F401
+    COLLECTIVE_KINDS, CalibrationError, CalibrationLookup,
+    CalibrationTable, chip_slug, load_table, lookup_for_chip,
+    save_table, table_path)

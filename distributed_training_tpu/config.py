@@ -132,6 +132,16 @@ class TrainConfig:
     # specs, and the CLI derives cfg.mesh from the plan (dp as the
     # elastic wildcard). Empty → legacy per-strategy specs.
     sharding_plan: str = ""
+    # Comms/compute overlap scheduling (parallel/overlap.py): when a
+    # plan is pinned, derive the XLA latency-hiding-scheduler (and
+    # collective-combiner) flags from it and append them to XLA_FLAGS
+    # before the backend initializes — the SimpleFSDP discipline of
+    # hiding FSDP's all-gather/reduce-scatter under compute via the
+    # COMPILER's schedule. The static overlap ratchet
+    # (analysis/OVERLAP_baseline.json) scores the same flags; flags
+    # already present in XLA_FLAGS are never overridden. False
+    # reproduces the unscheduled (pre-r07) behavior.
+    xla_overlap_flags: bool = True
     seed: int = 42
     optimizer: str = "sgd"        # "sgd" | "adamw" | "adafactor"
     weight_decay: float = 0.0

@@ -34,6 +34,9 @@ from distributed_training_tpu.resilience.elastic import GroupReport
 
 logger = logging.getLogger(__name__)
 
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
 # Exported per spawn attempt (see ``run_group``): which port-retry
 # attempt a child belongs to. Production children ignore it; tests use
 # it to script a first-attempt bind failure.
@@ -318,6 +321,54 @@ def run_group(argv: list[str], num_processes: int,
     return report
 
 
+def apply_overlap_flags_from_cmd(cmd: list[str],
+                                 platform: str = "cpu") -> list[str]:
+    """Scheduled comms/compute overlap for launched children: when
+    the train command pins a sharding plan
+    (``train.sharding_plan=<name|path>``), derive the plan's XLA
+    latency-hiding flags (``parallel/overlap.py``) and append them to
+    this process's ``XLA_FLAGS`` — ``launch_local`` builds every
+    child's env from it, so the whole simulated pod compiles the
+    scheduled program. Raw-JSON read, no planner import: a bad plan
+    stays the CHILD CLI's loud failure, not a launcher crash. Returns
+    the applied flag names (empty when no plan is pinned, the command
+    disables ``train.xla_overlap_flags``, or everything was already
+    set)."""
+    import yaml
+    plan_ref = None
+    enabled = True
+    for arg in cmd:
+        if arg.startswith("train.sharding_plan="):
+            plan_ref = arg.split("=", 1)[1]
+        elif arg.startswith("train.xla_overlap_flags="):
+            # Parse the override exactly as the child's config layer
+            # will (yaml.safe_load — 'off'/'False'/'no' are False,
+            # '0' is a falsy int the bool field keeps), and with the
+            # same LAST-WINS semantics over repeated overrides: the
+            # launcher must reach the same verdict the child's
+            # resolved config does.
+            try:
+                enabled = bool(yaml.safe_load(arg.split("=", 1)[1]))
+            except yaml.YAMLError:
+                pass  # the child CLI owns the loud parse failure
+    if not plan_ref or not enabled:
+        return []
+    path = plan_ref if os.path.exists(plan_ref) else os.path.join(
+        _REPO, "conf", "plans", f"{plan_ref}.json")
+    try:
+        with open(path, encoding="utf-8") as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return []  # child CLI owns the loud plan-load failure
+    from distributed_training_tpu.parallel import overlap
+    applied = overlap.apply_to_env(
+        overlap.flags_for_plan_doc(doc, platform))
+    if applied:
+        logger.info("comms/compute overlap: applied XLA flags %s "
+                    "for plan %s", applied, doc.get("name", path))
+    return applied
+
+
 def main(argv: list[str] | None = None) -> int:
     p = argparse.ArgumentParser(
         prog="dtt-launch-local",
@@ -363,6 +414,11 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--elastic-no-grow", action="store_true",
                    help="stay at the shrunken size for the rest of "
                         "the run")
+    p.add_argument("--no-overlap-flags", action="store_true",
+                   help="do not derive XLA latency-hiding-scheduler "
+                        "flags from a train.sharding_plan= override "
+                        "in the command (docs/performance.md "
+                        "'Scheduled comms/compute overlap')")
     p.add_argument("--metrics-port", type=int, default=0,
                    metavar="PORT",
                    help="serve the coordinator's live Prometheus "
@@ -380,6 +436,12 @@ def main(argv: list[str] | None = None) -> int:
         cmd = cmd + [f"train.metrics_port={args.metrics_port}"]
     if args.elastic and not args.supervise:
         p.error("--elastic requires --supervise")
+    if not args.no_overlap_flags:
+        # Children default to the CPU platform (launch_local) unless
+        # the caller's env says otherwise.
+        from distributed_training_tpu.parallel import overlap
+        apply_overlap_flags_from_cmd(
+            cmd, platform=overlap.platform_from_env("cpu"))
     if args.supervise:
         rc = _supervised_main(args, cmd)
     else:

@@ -1,0 +1,196 @@
+"""XLA comms/compute-overlap flags, derived from a sharding plan.
+
+SimpleFSDP's lesson (PAPERS.md, arXiv 2411.00284) is that FSDP's
+all-gather/reduce-scatter latency is hidden by COMPILER scheduling,
+not hand-written pipelining; TorchTitan ships that as a composable
+knob of the stack. The JAX equivalent is XLA's latency-hiding
+scheduler family, enabled per backend by flags. This module is the
+one place those flags are derived — from the plan, because the plan
+knows whether there is anything to hide (an unsharded mesh has no
+collectives) and how much per-step traffic the combiner should batch
+(its compile evidence records the measured collective bytes).
+
+Consumers: ``Plan.xla_overlap_flags()`` (the API surface),
+``train/cli.py`` and ``launch/local.py`` (apply to ``XLA_FLAGS``
+before backend init), ``benchmarks/bench_multichip.py`` (apply +
+record in MULTICHIP provenance), and the SPMD-audit targets
+(``analysis/targets.py`` passes them as per-compile
+``compiler_options`` so the overlap ratchet scores the schedule a
+flagged run executes).
+
+Per-platform sets:
+
+- ``tpu``: the latency-hiding scheduler + async-collective-fusion
+  set that public TPU training stacks (MaxText et al.) run with.
+- ``gpu``: the GPU latency-hiding scheduler plus collective-combiner
+  thresholds sized from the plan's measured per-step collective
+  bytes — combine everything a step moves, capped so the combiner
+  cannot create a multi-hundred-MB fusion bubble.
+- ``cpu``: the concurrency-optimized module scheduler — the CPU
+  backend's analogue (measured on the repo's fake-device meshes:
+  the r06 planned target's static overlap score rises 0.32 -> 0.92,
+  see ``analysis/OVERLAP_baseline.json``).
+
+The module itself depends on nothing but the stdlib — the derivation
+is pure data over plan JSON, and it never initializes a backend
+(importing it does execute the package ``__init__``s, which import
+the jax MODULE like every module in this repo; no device or compiler
+state is touched).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+# Flag VALUES are python types; ``render_xla_flags`` lowercases bools
+# for the env form, compiler_options passes them through (jax accepts
+# python bools/ints per-compile).
+TPU_OVERLAP_FLAGS = {
+    "xla_tpu_enable_latency_hiding_scheduler": True,
+    "xla_enable_async_all_gather": True,
+    "xla_enable_async_collective_permute": True,
+    "xla_tpu_enable_async_collective_fusion": True,
+    "xla_tpu_enable_async_collective_fusion_fuse_all_gather": True,
+    "xla_tpu_enable_async_collective_fusion_multiple_steps": True,
+    "xla_tpu_overlap_compute_collective_tc": True,
+}
+
+CPU_OVERLAP_FLAGS = {
+    "xla_cpu_enable_concurrency_optimized_scheduler": True,
+}
+
+GPU_OVERLAP_FLAGS = {
+    "xla_gpu_enable_latency_hiding_scheduler": True,
+}
+
+# Combiner-threshold clamp: at least 1 MiB (below that the combiner
+# is latency noise), at most 64 MiB (past that the combined
+# collective's memory spike outweighs the launch savings).
+_COMBINE_MIN = 1 << 20
+_COMBINE_MAX = 1 << 26
+
+
+def combine_threshold_bytes(collective_bytes_per_step) -> int:
+    """Combiner threshold from the plan's measured per-step
+    collective traffic: the next power of two at or above it, so one
+    step's collectives of a kind can combine into one launch,
+    clamped to [1 MiB, 64 MiB]."""
+    try:
+        nbytes = int(collective_bytes_per_step)
+    except (TypeError, ValueError):
+        nbytes = 0
+    thr = _COMBINE_MIN
+    while thr < nbytes and thr < _COMBINE_MAX:
+        thr <<= 1
+    return min(thr, _COMBINE_MAX)
+
+
+def platform_from_env(default: str = "", env=None) -> str:
+    """The platform a process WILL initialize, readable before the
+    backend exists: the first ``JAX_PLATFORMS`` entry, else
+    ``default``. The one shared resolution for every flag consumer
+    (cli / launcher / bench) — three hand-rolled copies would drift.
+    An empty result means "unknown": callers must derive NO flags
+    rather than guess a backend and trip an unknown-flag abort."""
+    env = os.environ if env is None else env
+    p = env.get("JAX_PLATFORMS", "").split(",")[0].strip()
+    return p or default
+
+
+def flags_for(platform: str, mesh: dict | None = None,
+              collective_bytes_per_step=None) -> dict:
+    """The overlap flag set for ``platform`` (``cpu``/``gpu``/``tpu``;
+    anything else — or an unsharded mesh, which compiles zero
+    collectives — gets ``{}``)."""
+    if mesh is not None and not any(
+            int(s) > 1 for s in mesh.values()):
+        return {}
+    p = (platform or "").lower()
+    if p == "tpu":
+        return dict(TPU_OVERLAP_FLAGS)
+    if p == "gpu":
+        flags = dict(GPU_OVERLAP_FLAGS)
+        thr = combine_threshold_bytes(collective_bytes_per_step)
+        for k in ("xla_gpu_all_gather_combine_threshold_bytes",
+                  "xla_gpu_reduce_scatter_combine_threshold_bytes",
+                  "xla_gpu_all_reduce_combine_threshold_bytes"):
+            flags[k] = thr
+        return flags
+    if p == "cpu":
+        return dict(CPU_OVERLAP_FLAGS)
+    return {}
+
+
+def flags_for_plan_doc(doc: dict, platform: str) -> dict:
+    """Flags from a RAW plan document (stdlib callers: the launcher
+    parent, the targets registry). The consuming half of
+    ``Plan.xla_overlap_flags`` — same derivation, no jax import."""
+    ev = (doc.get("provenance") or {}).get("compile_evidence") or {}
+    return flags_for(
+        platform, mesh=doc.get("mesh"),
+        collective_bytes_per_step=ev.get("collective_bytes_per_step"))
+
+
+def render_xla_flags(flags: dict) -> str:
+    """``--name=value`` space-joined, bools lowercased — the
+    ``XLA_FLAGS`` env form."""
+    def val(v):
+        return str(v).lower() if isinstance(v, bool) else str(v)
+    return " ".join(f"--{k}={val(v)}" for k, v in sorted(flags.items()))
+
+
+def _flag_names(xla_flags: str) -> set[str]:
+    """Flag NAMES present in an ``XLA_FLAGS`` string, tokenized — a
+    raw substring test would let a longer-named flag
+    (``..._fusion_fuse_all_gather``) shadow a shorter one
+    (``..._fusion``)."""
+    return set(re.findall(r"--([A-Za-z0-9_]+)(?==|\s|$)", xla_flags))
+
+
+def apply_to_env(flags: dict, env=None) -> list[str]:
+    """Append ``flags`` to ``env['XLA_FLAGS']`` and return the names
+    actually applied. A flag whose NAME is already set in the
+    existing value is left alone — an operator's explicit setting
+    (including an explicit ``...=false``) outranks the plan's
+    derivation. Must run before the backend initializes; callers own
+    that ordering (the planner-CLI / bench_multichip env discipline).
+    """
+    env = os.environ if env is None else env
+    existing = env.get("XLA_FLAGS", "")
+    names = _flag_names(existing)
+    fresh = {k: v for k, v in flags.items() if k not in names}
+    if not fresh:
+        return []
+    env["XLA_FLAGS"] = (existing + " "
+                        + render_xla_flags(fresh)).strip()
+    return sorted(fresh)
+
+
+def active_in_env(flags: dict, env=None) -> dict:
+    """Which of ``flags`` are present (by exact name) in
+    ``env['XLA_FLAGS']`` — provenance for ledger entries and
+    telemetry events. Values are read from the ENV string (the
+    operator may have set a flag to a different value than the plan
+    derives; provenance must report what actually ran)."""
+    env = os.environ if env is None else env
+    existing = env.get("XLA_FLAGS", "")
+    out = {}
+    for k in flags:
+        # LAST occurrence wins — XLA honors the final repetition of
+        # a flag, and provenance must report what actually ran.
+        ms = re.findall(r"--" + re.escape(k) + r"(?:=(\S+))?(?=\s|$)",
+                        existing)
+        if not ms:
+            continue
+        val = ms[-1] or None
+        if val is None:
+            out[k] = True
+        elif val.lower() in ("true", "false"):
+            out[k] = val.lower() == "true"
+        else:
+            try:
+                out[k] = int(val)
+            except ValueError:
+                out[k] = val
+    return out

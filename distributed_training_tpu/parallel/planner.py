@@ -28,9 +28,20 @@ there is exactly one of each:
 - throughput proxy: a compute/comms roofline — compute seconds from
   the model's FLOPs accounting × a remat recompute factor, comms
   seconds from an analytic per-step collective-bytes model (grad
-  sync over data axes, tp activation all-reduces, sp ring rotations)
-  against a nominal ICI bandwidth; step time = max(compute, comms)
-  × a pipeline-bubble factor. Score = tokens/step ÷ step seconds.
+  sync over data axes, tp activation all-reduces, sp ring rotations).
+  Both halves are CALIBRATED when a committed measurement exists
+  (``conf/calibration/<chip>.json`` — benchmarks/calibrate.py): the
+  comms half prices each collective KIND's bytes on the measured
+  piecewise latency/bandwidth curve, the compute half uses the
+  measured achievable-FLOPs curve instead of the spec-sheet peak.
+  Without a matching table each kind falls back to the per-chip
+  NOMINAL constants (``NOMINAL_ICI_BYTES_PER_S`` — per device kind,
+  so a v4 and a v5e rank differently where their interconnects
+  would). Which source scored a plan is recorded in provenance
+  (``calibration``) and verified by ``--check`` — re-calibrating the
+  chip fails every plan scored from the older table until it is
+  re-planned. Step time = max(compute, comms) × a pipeline-bubble
+  factor. Score = tokens/step ÷ step seconds.
 - reshard cleanliness: the top-ranked candidates are compiled
   abstractly (``analysis/compile.py`` — the REAL trainer, chip-free)
   and any ``SPMD001`` involuntary-reshard warning **disqualifies the
@@ -73,10 +84,34 @@ PLANS_DIR = os.path.join(REPO, "conf", "plans")
 REMAT_POLICIES = ("none", "mlp_pre", "mlp")
 REMAT_RECOMPUTE = {"none": 1.0, "mlp_pre": 1.02, "mlp": 1.04}
 
-# Nominal ICI link bandwidth for the comms half of the roofline. One
-# constant for ranking purposes (absolute step times are not the
-# claim; relative compute-vs-comms pressure is).
-ICI_BYTES_PER_S = 1.0e11
+# Nominal fallback ICI bandwidth for the comms half of the roofline
+# when no calibration table matches the target chip. PER DEVICE KIND
+# (spec-sheet interconnect numbers: v4 2.4 Tb/s, v5e 1.6, v5p 4.8,
+# v6e ~3.6; "cpu" keeps the historical ranking constant): absolute
+# step times are not the claim, but relative compute-vs-comms
+# pressure differs per chip, and pretending every kind has v5e's
+# wires mis-ranks candidates near the roofline crossover. Keyed by
+# the calibration layer's canonical chip slug so "v5 lite",
+# "v5litepod", and "v5e" all resolve to ONE row — nominal fallback
+# and measured-table lookup share a single normalization
+# (calibration/table.py::chip_slug).
+ICI_BYTES_PER_S = 1.0e11  # unknown-kind fallback (historical value)
+NOMINAL_ICI_BYTES_PER_S = {
+    "v4": 3.0e11,
+    "v5e": 2.0e11,
+    "v5p": 6.0e11,
+    "v6e": 4.48e11,
+    "cpu": 1.0e11,
+}
+
+
+def nominal_ici_bytes_per_s(chip: str) -> float:
+    """Per-kind nominal ICI bandwidth (same chip normalization as
+    the measured-table lookup; unknown kinds get the historical
+    one-size constant)."""
+    from distributed_training_tpu.calibration import chip_slug
+    return NOMINAL_ICI_BYTES_PER_S.get(chip_slug(chip),
+                                       ICI_BYTES_PER_S)
 
 MESH_AXES = ("pp", "dp", "fsdp", "sp", "tp")
 
@@ -157,6 +192,27 @@ _register(PlanTarget(
 ))
 
 
+_register(PlanTarget(
+    name="multichip_8dev_cpu",
+    devices=8,
+    model_kwargs=dict(vocab_size=256, d_model=64, n_heads=4,
+                      n_kv_heads=2, n_layers=2, max_seq_len=32,
+                      attention_impl="ring", attention_window=24,
+                      dtype="float32", param_dtype="float32"),
+    seq_len=32,
+    optimizer="adamw",
+    chip="cpu",
+    hbm_gib=16.0,
+    note="The multichip_8dev model scored against the MEASURED cpu "
+         "calibration table (conf/calibration/cpu.json, "
+         "benchmarks/calibrate.py) — the calibrated-cost-model path "
+         "exercised end-to-end in CI: planner --check validates this "
+         "plan's recorded calibration fingerprint against the "
+         "committed table, and benchmarks/bench_multichip.py "
+         "--plan multichip_8dev_cpu measures it (MULTICHIP_r07).",
+))
+
+
 def resolve_targets(names=None) -> list[PlanTarget]:
     if not names:
         return list(PLAN_TARGETS.values())
@@ -208,6 +264,20 @@ class Plan:
         (the --check winner comparison matches on it)."""
         m = ".".join(f"{a}{self.mesh[a]}" for a in MESH_AXES)
         return f"{m}/{self.remat}/b{self.batch_per_shard}"
+
+    def xla_overlap_flags(self, platform: str) -> dict:
+        """The XLA latency-hiding/combiner flag set this plan wants
+        on ``platform`` (``parallel/overlap.py`` — derived from the
+        plan's mesh and measured collective bytes; ``{}`` when there
+        is nothing to hide). Consumers: ``train/cli.py``,
+        ``launch/local.py``, ``benchmarks/bench_multichip.py``, and
+        the SPMD-audit targets (as per-compile compiler options)."""
+        from distributed_training_tpu.parallel import overlap
+        ev = (self.provenance or {}).get("compile_evidence") or {}
+        return overlap.flags_for(
+            platform, mesh=self.mesh,
+            collective_bytes_per_step=ev.get(
+                "collective_bytes_per_step"))
 
     def fingerprint(self) -> str:
         """Identity of the RESOLVED layout (search inputs included so
@@ -404,20 +474,59 @@ def hbm_budget_gib(target: PlanTarget) -> float:
     return cap * target.headroom
 
 
+def resolve_calibration(target: PlanTarget):
+    """The calibration feeding this target's cost model: a
+    ``CalibrationLookup`` for the committed
+    ``conf/calibration/<chip>.json`` matching ``target.chip``
+    (``table`` is None on fallback, ``status`` says why). One
+    resolution shared by ``plan_search`` and ``check_plan`` so the
+    search and its verifier can never consult different tables."""
+    from distributed_training_tpu.calibration import lookup_for_chip
+    return lookup_for_chip(target.chip)
+
+
+def calibration_provenance(target: PlanTarget, calib, note: str
+                           ) -> dict:
+    """The ``calibration`` block a plan's provenance records — the
+    drift anchor ``check_plan`` compares against the committed table
+    (source + fingerprint; ``nominal`` records the per-kind constants
+    actually used, so a nominal-scored plan drifts loudly too when
+    someone later lands a table for its chip)."""
+    from distributed_training_tpu.utils.metrics import (
+        peak_flops_per_chip)
+    if calib is not None:
+        return {"source": "measured", "chip": target.chip,
+                "device_kind": calib.device_kind,
+                "fingerprint": calib.fingerprint(), "note": note}
+    return {"source": "nominal", "chip": target.chip,
+            "fingerprint": None,
+            "nominal_ici_bytes_per_s": nominal_ici_bytes_per_s(
+                target.chip),
+            "nominal_peak_flops_per_s": peak_flops_per_chip(
+                target.chip),
+            "note": note}
+
+
 def score_candidate(target: PlanTarget, cand: Candidate,
-                    n_params: int | None = None) -> dict:
+                    n_params: int | None = None,
+                    calib="auto") -> dict:
     """Analytic feasibility + throughput proxy for one candidate.
 
     Returns a record with ``feasible`` (False carries ``reason``),
     the per-chip HBM estimate, the compute/comms roofline seconds,
     and ``score`` (tokens per second proxy — higher is better). Pure
-    function of (target, candidate): no clocks, no device state."""
+    function of (target, candidate, calibration table): no clocks,
+    no device state. ``calib`` is a ``CalibrationTable`` (measured
+    curves), ``None`` (per-kind nominal constants), or ``"auto"``
+    (resolve the committed table for ``target.chip``)."""
     from distributed_training_tpu.models.transformer import Transformer
     from distributed_training_tpu.utils.memory import (
         estimate_transformer_memory)
     from distributed_training_tpu.utils.metrics import (
         peak_flops_per_chip)
 
+    if calib == "auto":
+        calib = resolve_calibration(target).table
     cfg = _tf_cfg(target, cand.remat)
     if n_params is None:
         n_params = _n_params(target)
@@ -441,41 +550,62 @@ def score_candidate(target: PlanTarget, cand: Candidate,
         return rec
 
     # Compute roofline: model FLOPs at the candidate's global batch,
-    # scaled by the remat recompute factor, over every chip's peak.
+    # scaled by the remat recompute factor, over every chip's
+    # ACHIEVABLE throughput — the measured matmul curve when a
+    # calibration table matches the chip, the spec-sheet peak
+    # otherwise.
     model = Transformer(cfg)
     global_batch = cand.batch_per_shard * cand.dp * cand.fsdp
     flops_step = (model.flops_per_token(target.seq_len) * target.seq_len
                   * global_batch * REMAT_RECOMPUTE[cand.remat])
-    compute_s = flops_step / (target.devices
-                              * peak_flops_per_chip(target.chip))
+    flops_per_dev = flops_step / target.devices
+    if calib is not None:
+        compute_s = flops_per_dev / calib.achievable_flops_per_s(
+            flops_per_dev)
+    else:
+        compute_s = flops_per_dev / peak_flops_per_chip(target.chip)
 
-    # Comms roofline: analytic per-device bytes per step. param bytes
-    # use the stored dtype (grad sync moves masters), activation terms
-    # the compute dtype.
+    # Comms roofline: analytic per-device bytes per step, SPLIT BY
+    # COLLECTIVE KIND (the granularity calibration measures). param
+    # bytes use the stored dtype (grad sync moves masters),
+    # activation terms the compute dtype.
     pb = {"float32": 4, "bfloat16": 2, "float16": 2}[cfg.param_dtype]
     ab = {"float32": 4, "bfloat16": 2, "float16": 2}[cfg.dtype]
     P_store = n_params * pb / cand.pp
     B, S_l, D = cand.batch_per_shard, seq_local, cfg.d_model
     kv_width = cfg.n_kv_heads * cfg.head_dim
-    comms = 0.0
+    by_kind = {k: 0.0 for k in ("all-gather", "reduce-scatter",
+                                "all-reduce", "ppermute")}
     if cand.fsdp > 1:
         # Weights all-gather for compute (compute dtype) + gradient
         # reduce-scatter (stored dtype): each ~param-scale per step.
-        comms += n_params * ab / cand.pp + P_store
+        by_kind["all-gather"] += n_params * ab / cand.pp
+        by_kind["reduce-scatter"] += P_store
     if cand.dp > 1:
-        # Pure-replica gradient all-reduce over dp of each fsdp shard.
-        comms += 2.0 * P_store / cand.fsdp
+        # Pure-replica gradient all-reduce over dp of each fsdp shard
+        # (2x tensor bytes: the ring's RS+AG phases — the accounted-
+        # bytes convention calibration/table.py measures against).
+        by_kind["all-reduce"] += 2.0 * P_store / cand.fsdp
     if cand.tp > 1:
         # Activation all-reduces at the attn/mlp block boundaries,
-        # forward and backward.
-        comms += 4.0 * cfg.n_layers * B * S_l * D * ab
+        # forward and backward: 4 crossings per layer of a (B, S, D)
+        # tensor, each at the same 2x accounted-bytes convention as
+        # the dp term above (ring RS+AG phases) so one all-reduce
+        # curve prices both.
+        by_kind["all-reduce"] += (2.0 * 4.0 * cfg.n_layers
+                                  * B * S_l * D * ab)
     if cand.sp > 1:
         # Ring rotations: K/V around the ring in forward, K/V plus
         # their gradient accumulators in backward — ~3 full cycles of
         # 2 kv-width blocks.
-        comms += (6.0 * cfg.n_layers * B * S_l * kv_width * ab
-                  * (cand.sp - 1))
-    comms_s = comms / ICI_BYTES_PER_S
+        by_kind["ppermute"] += (6.0 * cfg.n_layers * B * S_l
+                                * kv_width * ab * (cand.sp - 1))
+    comms = sum(by_kind.values())
+    if calib is not None:
+        comms_s = sum(calib.collective_seconds(k, b)
+                      for k, b in by_kind.items() if b > 0)
+    else:
+        comms_s = comms / nominal_ici_bytes_per_s(target.chip)
 
     bubble = ((cand.pp - 1) / max(1, cfg.pp_microbatches)
               if cand.pp > 1 else 0.0)
@@ -487,20 +617,27 @@ def score_candidate(target: PlanTarget, cand: Candidate,
         compute_s=compute_s,
         comms_s=comms_s,
         comms_bytes=int(comms),
+        comms_bytes_by_kind={k: int(b) for k, b in by_kind.items()
+                             if b > 0},
+        calibrated=calib is not None,
         tokens_per_step=tokens,
         score=tokens / step_s if step_s > 0 else 0.0,
     )
     return rec
 
 
-def rank_candidates(target: PlanTarget) -> list[tuple[Candidate, dict]]:
+def rank_candidates(target: PlanTarget, calib="auto"
+                    ) -> list[tuple[Candidate, dict]]:
     """Feasible candidates best-first. Deterministic: the sort key is
     (-score, simplest-mesh-first, largest-batch-first, remat order) —
     ties between layouts with equal throughput proxies break toward
     fewer sharded axes (less to go wrong) and then lexical mesh
-    order, so the same target can never rank two ways."""
+    order, so the same (target, calibration) can never rank two
+    ways. The table is resolved ONCE for the whole ranking."""
+    if calib == "auto":
+        calib = resolve_calibration(target).table
     n_params = _n_params(target)
-    scored = [(c, score_candidate(target, c, n_params))
+    scored = [(c, score_candidate(target, c, n_params, calib=calib))
               for c in enumerate_candidates(target)]
     feasible = [(c, s) for c, s in scored if s["feasible"]]
     remat_order = {r: i for i, r in enumerate(REMAT_POLICIES)}
@@ -634,10 +771,16 @@ def compile_verify(target: PlanTarget, plan: Plan) -> dict:
                 dtype=plan.inputs.get("model_kwargs", {}).get(
                     "dtype", "float32"),
                 optimizer=target.optimizer))
+        # Compile with the plan's overlap flags for this (cpu)
+        # backend: the verification path IS the consumption path, and
+        # consumers run the latency-hiding schedule (cli/bench apply
+        # the same flags via XLA_FLAGS).
+        opts = plan.xla_overlap_flags("cpu") or None
         with collectives.capture_stderr_fd() as cap:
             text = trainer._step_fn.lower(
                 trainer.state, batch,
-                jnp.zeros((2,), jnp.uint32)).compile().as_text()
+                jnp.zeros((2,), jnp.uint32)).compile(
+                    compiler_options=opts).as_text()
         warnings = collectives.parse_reshard_warnings(cap.text)
         coll = collectives.audit_hlo_text(text, mesh=rt.mesh)
     return {
@@ -659,7 +802,15 @@ def plan_search(target: PlanTarget,
     candidate dirty — a planner that silently shipped a resharding
     layout would defeat its own reason to exist."""
     verify = verify_fn or compile_verify
-    ranked = rank_candidates(target)
+    lookup = resolve_calibration(target)
+    calib, calib_note = lookup.table, lookup.note
+    if lookup.status == "unusable":
+        # A TAMPERED/CORRUPT committed table is a loud event even
+        # though the search proceeds on nominal constants — the
+        # provenance note below records it durably, this line makes
+        # it visible at plan time.
+        print(f"[planner] WARNING: {calib_note}")
+    ranked = rank_candidates(target, calib=calib)
     if not ranked:
         raise PlanError(
             f"target '{target.name}': no feasible candidate "
@@ -684,6 +835,8 @@ def plan_search(target: PlanTarget,
             "ranking": ranking,
             "disqualified": disqualified,
             "compile_evidence": evidence,
+            "calibration": calibration_provenance(
+                target, calib, calib_note),
         }
         return plan
     raise PlanError(
@@ -856,6 +1009,10 @@ def check_plan(target: PlanTarget,
 
     - the committed plan must load, be for this target's inputs, and
       carry a self-consistent fingerprint;
+    - the calibration that scored the plan must still be the one the
+      chip resolves to (same source, same committed-table
+      fingerprint): re-measuring a chip — or landing/removing its
+      table — without re-planning is silent cost-model drift;
     - the deterministic stage-1 ranking must match the one recorded
       at plan time (a cost-model or search-space change silently
       reordering candidates is exactly what must not pass CI);
@@ -878,7 +1035,28 @@ def check_plan(target: PlanTarget,
             f"{target.name}: committed plan was resolved for "
             "different search inputs — re-run planner --write")
         return problems
-    ranked = rank_candidates(target)
+    lookup = resolve_calibration(target)
+    calib, calib_note = lookup.table, lookup.note
+    if lookup.status == "unusable":
+        # A committed table whose own integrity check rejects it is
+        # repo damage, not a fallback case: plan_search may proceed
+        # on nominal constants mid-recalibration, but --check guards
+        # COMMITTED state and must go red until the artifact is
+        # re-measured or removed.
+        problems.append(
+            f"{target.name}: {calib_note}")
+        return problems
+    recorded_calib = committed.provenance.get("calibration", {})
+    current_fp = calib.fingerprint() if calib is not None else None
+    if recorded_calib.get("fingerprint") != current_fp:
+        problems.append(
+            f"{target.name}: calibration drift — plan was scored "
+            f"from {recorded_calib.get('source', 'nominal')} "
+            f"(fingerprint {recorded_calib.get('fingerprint')}), the "
+            f"chip now resolves to fingerprint {current_fp} "
+            f"({calib_note}) — re-run planner --write")
+        return problems
+    ranked = rank_candidates(target, calib=calib)
     ranking = [{"candidate": c.key, "score": s["score"]}
                for c, s in ranked]
     recorded = committed.provenance.get("ranking", [])
@@ -974,6 +1152,7 @@ def main(argv=None) -> int:
             plan = plan_search(t)
             path = save_plan(plan)
             ev = plan.provenance["compile_evidence"]
+            cal = plan.provenance.get("calibration", {})
             print(f"[planner] {t.name}: wrote {path}")
             print(f"[planner]   mesh="
                   f"{ {a: s for a, s in plan.mesh.items() if s > 1} } "
@@ -983,6 +1162,9 @@ def main(argv=None) -> int:
             print(f"[planner]   reshard_warnings="
                   f"{ev['spmd_reshard_warnings']} collective_bytes="
                   f"{ev['collective_bytes_per_step']}")
+            print(f"[planner]   cost model: "
+                  f"{cal.get('source', 'nominal')} "
+                  f"({cal.get('note', '')})")
             if args.json:
                 with open(args.json, "w", encoding="utf-8") as f:
                     json.dump(plan.to_doc(), f, indent=1,
@@ -996,9 +1178,13 @@ def main(argv=None) -> int:
                 rc = 1
             else:
                 plan = load_plan(t.name)
+                cal = plan.provenance.get("calibration", {})
                 print(f"[planner] {t.name}: OK "
                       f"(fingerprint {plan.fingerprint()}, "
-                      f"reshard-clean, winner unchanged)")
+                      f"reshard-clean, winner unchanged, "
+                      f"cost model {cal.get('source', 'nominal')}"
+                      + (f"@{cal['fingerprint']}"
+                         if cal.get("fingerprint") else "") + ")")
         else:
             ranked = rank_candidates(t)
             print(f"[planner] {t.name}: "

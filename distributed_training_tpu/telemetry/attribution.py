@@ -65,10 +65,15 @@ SUMMARY_KEYS = ("schema", "step", "steps_captured", "trace_dir",
                 "compute_s", "collective_s", "overlap_s", "error")
 
 # Same for the one-shot ``attribution_static`` event.
+# ``xla_overlap_flags``: which plan-derived latency-hiding flags were
+# ACTIVE in this process's XLA_FLAGS (parallel/overlap.py) — the
+# provenance that makes a static score attributable to its scheduler
+# config. Additive; SCHEMA stays 1.
 STATIC_SUMMARY_KEYS = ("schema", "step", "scored", "overlapped",
                        "overlap_score", "mean_compute_between",
                        "async_pairs", "expected_comms_s",
-                       "expected_compute_s", "sharding_plan")
+                       "expected_compute_s", "sharding_plan",
+                       "xla_overlap_flags")
 
 
 def summary_of_event(rec: dict, keys=SUMMARY_KEYS) -> dict:

@@ -48,6 +48,7 @@ def main(argv: list[str] | None = None) -> int:
     os.makedirs(run_dir, exist_ok=True)
 
     plan = None
+    applied_overlap_flags: list[str] = []
     if cfg.train.sharding_plan:
         # Pinned auto-parallelism plan (parallel/planner.py): the mesh
         # is DERIVED from it — model-sharding axes pinned to the
@@ -57,6 +58,21 @@ def main(argv: list[str] | None = None) -> int:
         # Trainer re-validates the resolved mesh against the plan.
         from distributed_training_tpu.parallel import planner
         plan = planner.apply_plan_to_config(cfg)
+        if cfg.train.xla_overlap_flags:
+            # Scheduled comms/compute overlap: the plan's XLA
+            # latency-hiding flags must land in XLA_FLAGS BEFORE the
+            # first backend init (initialize_runtime below), or the
+            # compiler schedules without them. Platform must be known
+            # without touching the backend — the env/device config is
+            # authoritative; "auto" with no env stays unflagged (a
+            # log line says so) rather than guessing wrong and
+            # tripping an unknown-flag abort on another backend.
+            from distributed_training_tpu.parallel import overlap
+            platform = overlap.platform_from_env(
+                cfg.train.device if cfg.train.device != "auto"
+                else "")
+            applied_overlap_flags = overlap.apply_to_env(
+                plan.xla_overlap_flags(platform))
 
     rt = initialize_runtime(cfg)
     setup_logging(cfg.run.log_level,
@@ -66,6 +82,13 @@ def main(argv: list[str] | None = None) -> int:
         # After setup_logging, or the line never reaches the run log.
         logger.info("sharding plan %s@%s: mesh derived %s",
                     plan.name, plan.fingerprint(), plan.mesh)
+        if applied_overlap_flags:
+            logger.info("comms/compute overlap: applied XLA flags %s",
+                        applied_overlap_flags)
+        elif cfg.train.xla_overlap_flags:
+            logger.info("comms/compute overlap: no flags applied "
+                        "(already set, platform unknown, or nothing "
+                        "to hide on this mesh)")
     from distributed_training_tpu.resilience import elastic
     if cfg.train.global_batch_size:
         # Elastic contract: the GLOBAL batch is world-size-invariant;

@@ -702,6 +702,14 @@ class Trainer:
             rep["sharding_plan"] = {
                 "name": self.plan.name,
                 "fingerprint": self.plan.fingerprint()}
+            # Scheduler provenance: which plan-derived latency-hiding
+            # flags this process actually ran with (cli/launch/bench
+            # apply them to XLA_FLAGS; an operator may also have set
+            # or suppressed them by hand) — so the static score is
+            # attributable to its scheduler config.
+            from distributed_training_tpu.parallel import overlap
+            rep["xla_overlap_flags"] = overlap.active_in_env(
+                self.plan.xla_overlap_flags(self.rt.platform))
         self.telemetry.event("attribution_static", **rep)
 
     def _run_epoch(self, epoch: int) -> dict[str, float]:

@@ -403,6 +403,42 @@ def test_overlap_pin_outranks_baseline(tmp_path):
                                min_overlap={"t1": 0.25})
 
 
+def test_write_baseline_refuses_to_lower_raised_floor(tmp_path):
+    """The ratchet only tightens by default: a regressed score (or
+    vanished evidence) cannot ride a routine --write-baseline into a
+    lower committed floor; an intentional slackening passes
+    allow_lower explicitly and still cannot cross a pin."""
+    path = str(tmp_path / "OVERLAP_baseline.json")
+    baseline.write_overlap(_doc(0.9), path=path)
+    with pytest.raises(ValueError, match="LOWER"):
+        baseline.write_overlap(_doc(0.5), path=path)
+    with pytest.raises(ValueError, match="LOWER"):
+        baseline.write_overlap(_doc(None, scored=0), path=path)
+    # The refusals left the committed floor untouched.
+    assert baseline.load_overlap(path)["targets"]["t1"][
+        "overlap_score"] == 0.9
+    baseline.write_overlap(_doc(0.5), path=path, allow_lower=True)
+    assert baseline.load_overlap(path)["targets"]["t1"][
+        "overlap_score"] == 0.5
+    with pytest.raises(ValueError, match="min_overlap pin"):
+        baseline.write_overlap(_doc(0.1), path=path,
+                               allow_lower=True,
+                               min_overlap={"t1": 0.25})
+    # Raising the floor needs no ceremony.
+    baseline.write_overlap(_doc(0.95), path=path)
+    assert baseline.load_overlap(path)["targets"]["t1"][
+        "overlap_score"] == 0.95
+    # A target VANISHING from the audit doc must not silently drop
+    # its baselined floor either.
+    with pytest.raises(ValueError, match="DROP"):
+        baseline.write_overlap(_doc(0.5, target="other"), path=path)
+    assert baseline.load_overlap(path)["targets"]["t1"][
+        "overlap_score"] == 0.95
+    baseline.write_overlap(_doc(0.5, target="other"), path=path,
+                           allow_lower=True)
+    assert "t1" not in baseline.load_overlap(path)["targets"]
+
+
 def test_committed_overlap_baseline_matches_targets():
     """The committed OVERLAP_baseline.json covers every audit target
     with a min_overlap pin, at or above the pin — the gate's

@@ -1,0 +1,507 @@
+"""Calibrated cost model + scheduled comms/compute overlap.
+
+Four layers:
+- calibration tables (calibration/table.py): round-trip, fingerprint
+  tamper refusal, interpolation semantics (latency floor / piecewise /
+  tail extrapolation), chip-slug normalization, and the
+  fallback-to-nominal lookup contract (missing vs unusable, loud
+  note either way);
+- planner consumption (parallel/planner.py): per-kind nominal
+  fallback table (v4 and v5e RANK DIFFERENTLY where their wires
+  should), calibrated ranking determinism, per-kind pricing actually
+  steering the winner, calibration provenance on committed plans,
+  and --check catching calibration drift;
+- overlap flag derivation (parallel/overlap.py): per-platform sets,
+  combiner-threshold clamping, env application that never overrides
+  an operator's explicit setting, Plan.xla_overlap_flags and the
+  stdlib plan-doc path agreeing, the launcher's cmd-scan application;
+- the committed artifacts: conf/calibration/cpu.json matches the
+  multichip_8dev_cpu plan's recorded fingerprint, the nominal-scored
+  v5e plan says so, the planned audit target carries the overlap
+  compiler options, and MULTICHIP_r07.json embeds calibration + flag
+  provenance with a measured improvement over r06.
+"""
+
+import dataclasses
+import json
+import os
+
+import pytest
+
+from distributed_training_tpu.calibration import (CalibrationError,
+                                                  CalibrationTable,
+                                                  chip_slug,
+                                                  load_table,
+                                                  lookup_for_chip,
+                                                  save_table)
+from distributed_training_tpu.parallel import overlap, planner
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CPU_TABLE_PATH = os.path.join(REPO, "conf", "calibration", "cpu.json")
+
+
+def _pts(rate, latency):
+    return [[b, latency + b / rate] for b in (1e4, 1e6, 1e8)]
+
+
+def _table(device_kind="cpu", ag_rate=1e9):
+    return CalibrationTable(
+        device_kind=device_kind, platform="cpu", n_devices=8,
+        collectives={
+            "all-gather": _pts(ag_rate, 1e-4),
+            "reduce-scatter": _pts(2e9, 1e-4),
+            "all-reduce": _pts(1e10, 5e-5),
+            "ppermute": _pts(1e9, 1e-4),
+        },
+        matmul=[[1e6, 5e10], [1e9, 1e11], [1e12, 1.4e11]],
+        meta={"synthetic": True})
+
+
+# ---------------------------------------------------------------------------
+# Table artifact
+# ---------------------------------------------------------------------------
+
+
+def test_table_round_trip(tmp_path):
+    t = _table()
+    path = str(tmp_path / "cpu.json")
+    save_table(t, path)
+    loaded = load_table(path)
+    assert loaded.fingerprint() == t.fingerprint()
+    assert loaded.to_doc() == json.loads(json.dumps(t.to_doc()))
+
+
+def test_table_tamper_refusal(tmp_path):
+    """A hand-edited point (or curve) must refuse to load: every plan
+    scored from the table inherits its numbers."""
+    doc = _table().to_doc()
+    doc["collectives"]["all-gather"][0][1] *= 10  # forge a latency
+    p = tmp_path / "cpu.json"
+    p.write_text(json.dumps(doc))
+    with pytest.raises(CalibrationError, match="fingerprint"):
+        load_table(str(p))
+
+
+def test_table_interpolation_semantics():
+    t = _table()
+    pts = t.collectives["all-gather"]
+    # Below the smallest measured point: the latency floor, not a
+    # linear-through-zero fantasy.
+    assert t.collective_seconds("all-gather", 1.0) == pts[0][1]
+    # At a measured point: exactly that measurement.
+    assert t.collective_seconds("all-gather", 1e6) == \
+        pytest.approx(pts[1][1])
+    # Between points: strictly between their times.
+    mid = t.collective_seconds("all-gather", 5e5)
+    assert pts[0][1] < mid < pts[1][1]
+    # Above the largest: tail-bandwidth extrapolation keeps growing.
+    assert t.collective_seconds("all-gather", 1e9) > pts[2][1]
+    # Unknown kind is a loud error, not a silent zero-cost collective.
+    with pytest.raises(CalibrationError, match="no curve"):
+        t.collective_seconds("all-to-all", 1e6)
+    # Matmul curve clamps at both ends (achievable FLOPs saturate).
+    assert t.achievable_flops_per_s(1.0) == t.matmul[0][1]
+    assert t.achievable_flops_per_s(1e15) == t.matmul[-1][1]
+    lo, hi = t.matmul[0][1], t.matmul[1][1]
+    assert lo < t.achievable_flops_per_s(5e8) < hi
+
+
+def test_chip_slug_normalization():
+    assert chip_slug("TPU v5 lite") == "v5e"
+    assert chip_slug("v5e") == "v5e"
+    assert chip_slug("TPU v4") == "v4"
+    assert chip_slug("cpu") == "cpu"
+    assert chip_slug("Banana 9000") == "banana_9000"
+
+
+def test_lookup_fallback_contract(tmp_path):
+    """Missing table -> nominal, status 'missing'; unusable
+    (tampered) table -> nominal, status 'unusable' with a LOUD
+    falling-back note; good table -> status 'measured' with its
+    fingerprint in the note. Status is the structured signal
+    consumers branch on — the prose note is free to be reworded."""
+    calib_dir = str(tmp_path)
+    lk = lookup_for_chip("v5e", calib_dir)
+    assert lk.table is None and lk.status == "missing"
+    assert "no committed calibration table" in lk.note
+
+    t = _table(device_kind="v5e")
+    save_table(t, os.path.join(calib_dir, "v5e.json"))
+    lk = lookup_for_chip("v5e", calib_dir)
+    assert lk.table is not None and lk.status == "measured"
+    assert t.fingerprint() in lk.note
+
+    doc = t.to_doc()
+    doc["matmul"][0][1] *= 2  # tamper
+    with open(os.path.join(calib_dir, "v5e.json"), "w") as f:
+        json.dump(doc, f)
+    lk = lookup_for_chip("v5e", calib_dir)
+    assert lk.table is None and lk.status == "unusable"
+    assert "FALLING BACK" in lk.note
+
+    # Structurally malformed docs (missing keys, wrong point shapes)
+    # must also land in the loud fallback, never a planner-bricking
+    # traceback.
+    for bad in ({"schema": 1},
+                {**t.to_doc(), "collectives": {"all-gather": 5}},
+                {k: v for k, v in t.to_doc().items()
+                 if k != "matmul"}):
+        with open(os.path.join(calib_dir, "v5e.json"), "w") as f:
+            json.dump(bad, f)
+        lk = lookup_for_chip("v5e", calib_dir)
+        assert lk.table is None and lk.status == "unusable", bad
+
+
+# ---------------------------------------------------------------------------
+# Planner consumption
+# ---------------------------------------------------------------------------
+
+
+def _ranking_target(chip, **over):
+    kw = dict(
+        name="t", devices=8,
+        model_kwargs=dict(vocab_size=256, d_model=128, n_heads=8,
+                          n_kv_heads=4, n_layers=2, max_seq_len=256,
+                          attention_impl="ring", attention_window=248,
+                          dtype="float32", param_dtype="float32"),
+        seq_len=256, chip=chip, hbm_gib=16.0,
+        batch_candidates=(4, 8))
+    kw.update(over)
+    return planner.PlanTarget(**kw)
+
+
+def test_nominal_table_is_per_kind():
+    assert planner.nominal_ici_bytes_per_s("v4") == 3.0e11
+    assert planner.nominal_ici_bytes_per_s("TPU v5 lite") == 2.0e11
+    assert planner.nominal_ici_bytes_per_s("v5e") == 2.0e11
+    # Unknown kinds keep the historical one-size constant.
+    assert (planner.nominal_ici_bytes_per_s("banana")
+            == planner.ICI_BYTES_PER_S)
+
+
+def test_v4_and_v5e_rank_differently_where_they_should():
+    """The satellite fix pinned: one nominal bandwidth used to make
+    every chip rank identically. v4's faster wires (3e11 vs 2e11
+    B/s) keep a comms-capped fsdp candidate competitive that v5e's
+    roofline demotes — the two chips must produce different orders
+    over the SAME candidate set."""
+    v4 = [c.key for c, _s in planner.rank_candidates(
+        _ranking_target("v4"), calib=None)]
+    v5e = [c.key for c, _s in planner.rank_candidates(
+        _ranking_target("v5e"), calib=None)]
+    assert sorted(v4) == sorted(v5e)  # same candidates...
+    assert v4 != v5e                  # ...different order
+    # And the comms half prices exactly by the nominal ratio.
+    cand = planner.Candidate(1, 1, 8, 1, 1, "none", 8)
+    n_params = planner._n_params(_ranking_target("v4"))
+    s4 = planner.score_candidate(_ranking_target("v4"), cand,
+                                 n_params, calib=None)
+    s5 = planner.score_candidate(_ranking_target("v5e"), cand,
+                                 n_params, calib=None)
+    assert s4["comms_s"] == pytest.approx(
+        s5["comms_s"] * 2.0e11 / 3.0e11)
+
+
+def test_calibrated_ranking_is_deterministic():
+    t = _ranking_target("cpu")
+    calib = _table()
+    a = [(c.key, s["score"])
+         for c, s in planner.rank_candidates(t, calib=calib)]
+    b = [(c.key, s["score"])
+         for c, s in planner.rank_candidates(t, calib=calib)]
+    assert a == b and a
+    # The calibrated flag rides every record, honestly.
+    ranked = planner.rank_candidates(t, calib=calib)
+    assert all(s["calibrated"] for _c, s in ranked)
+    assert planner.rank_candidates(t, calib=None)[0][1][
+        "calibrated"] is False
+
+
+def test_per_kind_pricing_steers_the_winner():
+    """A measured curve that says THIS interconnect all-gathers
+    terribly must demote fsdp (all-gather + reduce-scatter traffic)
+    below pure dp (all-reduce traffic) — per-kind pricing is the
+    point of calibrating per collective."""
+    t = _ranking_target("cpu", batch_candidates=(8,),
+                        remat_candidates=("none",))
+    fair = _table()
+    slow_ag = _table(ag_rate=1e5)  # all-gather 10,000x slower
+    top_fair = [c.key for c, _s in
+                planner.rank_candidates(t, calib=fair)]
+    top_slow = [c.key for c, _s in
+                planner.rank_candidates(t, calib=slow_ag)]
+    fsdp8 = "pp1.dp1.fsdp8.sp1.tp1/none/b8"
+    dp8 = "pp1.dp8.fsdp1.sp1.tp1/none/b8"
+    # Equal-cost curves keep the historical tie-break (fsdp first)...
+    assert top_fair.index(fsdp8) < top_fair.index(dp8)
+    # ...a slow all-gather flips it.
+    assert top_slow.index(dp8) < top_slow.index(fsdp8)
+
+
+def test_committed_cpu_table_is_sane():
+    """Physical sanity on the committed measurement: every curve is
+    (noise-tolerantly) non-decreasing in bytes, and all-reduce at
+    the largest accounted size costs within 3x of reduce-scatter —
+    the misaccounting this pins (a sharded psum operand timing 1/n
+    of the tensor) made all-reduce ~10x cheaper than its ring
+    phases' parts."""
+    t = load_table(CPU_TABLE_PATH)
+    for kind, pts in t.collectives.items():
+        for (b0, t0), (b1, t1) in zip(pts, pts[1:]):
+            assert t1 >= t0 * 0.8, (kind, pts)
+    top = t.collectives["reduce-scatter"][-1][0]
+    ar = t.collective_seconds("all-reduce", top)
+    rs = t.collective_seconds("reduce-scatter", top)
+    assert rs / 3 <= ar <= rs * 3, (ar, rs)
+
+
+def test_committed_cpu_plan_matches_committed_table():
+    """The calibrated-cost-model path as committed: the
+    multichip_8dev_cpu plan records source=measured with the EXACT
+    fingerprint of conf/calibration/cpu.json, and check_plan (the
+    tier-1 planner gate's unit) passes."""
+    plan = planner.load_plan("multichip_8dev_cpu")
+    cal = plan.provenance["calibration"]
+    assert cal["source"] == "measured"
+    assert cal["fingerprint"] == load_table(
+        CPU_TABLE_PATH).fingerprint()
+    assert planner.check_plan(
+        planner.PLAN_TARGETS["multichip_8dev_cpu"]) == []
+
+
+def test_committed_v5e_plan_records_nominal_fallback():
+    """No v5e table is committed: the multichip_8dev plan must SAY
+    its scores are nominal (and which constants were used), not
+    pretend to be measured."""
+    plan = planner.load_plan("multichip_8dev")
+    cal = plan.provenance["calibration"]
+    assert cal["source"] == "nominal"
+    assert cal["fingerprint"] is None
+    assert cal["nominal_ici_bytes_per_s"] == 2.0e11
+    assert "no committed calibration table" in cal["note"]
+
+
+def test_check_plan_catches_calibration_drift(monkeypatch):
+    """Re-measuring a chip (new table fingerprint) — or losing the
+    table — without re-planning must fail --check, BEFORE the
+    generic ranking-drift message: the operator should be told the
+    calibration moved, not left diffing candidate lists."""
+    from distributed_training_tpu.calibration import CalibrationLookup
+    target = planner.PLAN_TARGETS["multichip_8dev_cpu"]
+    # Table vanished / unusable -> nominal != recorded measured.
+    monkeypatch.setattr(
+        planner, "resolve_calibration",
+        lambda _t: CalibrationLookup(
+            None, "no committed calibration table (test)", "missing"))
+    problems = planner.check_plan(target)
+    assert problems and "calibration drift" in problems[0]
+    # A DIFFERENT measurement -> fingerprint mismatch.
+    other = _table()
+    monkeypatch.setattr(
+        planner, "resolve_calibration",
+        lambda _t: CalibrationLookup(other, "calibrated (test)",
+                                     "measured"))
+    problems = planner.check_plan(target)
+    assert problems and "calibration drift" in problems[0]
+    # An UNUSABLE committed table is repo damage: --check goes red
+    # even though plan_search would proceed on nominal constants
+    # (and even for a nominal-scored plan, where the fingerprint
+    # comparison alone would see None == None).
+    monkeypatch.setattr(
+        planner, "resolve_calibration",
+        lambda _t: CalibrationLookup(
+            None, "committed calibration table x is unusable "
+            "(test); FALLING BACK", "unusable"))
+    problems = planner.check_plan(target)
+    assert problems and "unusable" in problems[0]
+
+
+# ---------------------------------------------------------------------------
+# Overlap flag derivation + application
+# ---------------------------------------------------------------------------
+
+
+def test_overlap_flags_per_platform():
+    cpu = overlap.flags_for("cpu")
+    assert cpu["xla_cpu_enable_concurrency_optimized_scheduler"] \
+        is True
+    tpu = overlap.flags_for("tpu")
+    assert tpu["xla_tpu_enable_latency_hiding_scheduler"] is True
+    gpu = overlap.flags_for("gpu", collective_bytes_per_step=891208)
+    assert gpu["xla_gpu_enable_latency_hiding_scheduler"] is True
+    # Combiner thresholds derived from the plan's measured bytes.
+    assert gpu["xla_gpu_all_gather_combine_threshold_bytes"] == 1 << 20
+    assert overlap.flags_for("banana") == {}
+    # An unsharded mesh compiles zero collectives: nothing to hide.
+    assert overlap.flags_for(
+        "cpu", mesh={"dp": 1, "fsdp": 1, "tp": 1}) == {}
+
+
+def test_combine_threshold_clamps():
+    assert overlap.combine_threshold_bytes(None) == 1 << 20
+    assert overlap.combine_threshold_bytes(0) == 1 << 20
+    assert overlap.combine_threshold_bytes(5 << 20) == 8 << 20
+    assert overlap.combine_threshold_bytes(1 << 30) == 1 << 26
+
+
+def test_render_and_apply_to_env():
+    flags = {"xla_cpu_enable_concurrency_optimized_scheduler": True,
+             "xla_gpu_all_gather_combine_threshold_bytes": 1 << 20}
+    rendered = overlap.render_xla_flags(flags)
+    assert ("--xla_cpu_enable_concurrency_optimized_scheduler=true"
+            in rendered)
+    assert ("--xla_gpu_all_gather_combine_threshold_bytes=1048576"
+            in rendered)
+    env = {"XLA_FLAGS": "--xla_force_host_platform_device_count=8"}
+    applied = overlap.apply_to_env(flags, env)
+    assert applied == sorted(flags)
+    assert "--xla_force_host_platform_device_count=8" \
+        in env["XLA_FLAGS"]
+    # Idempotent: a second application is a no-op.
+    assert overlap.apply_to_env(flags, env) == []
+    # An operator's explicit setting (even =false) outranks the plan.
+    env2 = {"XLA_FLAGS":
+            "--xla_cpu_enable_concurrency_optimized_scheduler=false"}
+    applied2 = overlap.apply_to_env(
+        {"xla_cpu_enable_concurrency_optimized_scheduler": True},
+        env2)
+    assert applied2 == []
+    assert "=false" in env2["XLA_FLAGS"]
+    assert overlap.active_in_env(flags, env)
+    assert overlap.active_in_env(flags, {"XLA_FLAGS": ""}) == {}
+
+
+def test_flag_names_tokenized_not_substring_matched():
+    """A longer-named flag in the env must not shadow a shorter one
+    that is its prefix, and active_in_env must report the ENV's
+    actual value, not the plan's derivation."""
+    env = {"XLA_FLAGS": "--xla_tpu_enable_async_collective_fusion"
+                        "_fuse_all_gather=false"}
+    applied = overlap.apply_to_env(dict(overlap.TPU_OVERLAP_FLAGS),
+                                   env)
+    # The base fusion flag is NOT suppressed by its longer sibling...
+    assert "xla_tpu_enable_async_collective_fusion" in applied
+    # ...while the operator's explicit sub-flag stays untouched.
+    assert "xla_tpu_enable_async_collective_fusion_fuse_all_gather" \
+        not in applied
+    assert env["XLA_FLAGS"].count(
+        "_fuse_all_gather=false") == 1
+    active = overlap.active_in_env(overlap.TPU_OVERLAP_FLAGS, env)
+    # Provenance reports what actually ran: the env's =false, not
+    # the plan's derived True.
+    assert active[
+        "xla_tpu_enable_async_collective_fusion_fuse_all_gather"] \
+        is False
+    assert active["xla_tpu_enable_async_collective_fusion"] is True
+    # Repeated flag: XLA honors the LAST occurrence; so must
+    # provenance.
+    env3 = {"XLA_FLAGS": "--xla_gpu_all_reduce_combine_threshold_"
+                         "bytes=1048576 --xla_gpu_all_reduce_"
+                         "combine_threshold_bytes=67108864"}
+    assert overlap.active_in_env(
+        {"xla_gpu_all_reduce_combine_threshold_bytes": 1 << 20},
+        env3) == {"xla_gpu_all_reduce_combine_threshold_bytes":
+                  67108864}
+
+
+def test_plan_surface_and_doc_path_agree():
+    """Plan.xla_overlap_flags (the API surface) and the stdlib
+    flags_for_plan_doc (launcher/targets path) must derive the same
+    set — two derivations would drift."""
+    plan = planner.load_plan("multichip_8dev")
+    with open(planner.plan_path("multichip_8dev"),
+              encoding="utf-8") as f:
+        doc = json.load(f)
+    for platform in ("cpu", "tpu", "gpu"):
+        assert plan.xla_overlap_flags(platform) == \
+            overlap.flags_for_plan_doc(doc, platform)
+    assert plan.xla_overlap_flags("cpu")  # non-empty: fsdp8 mesh
+    # An unsharded plan derives nothing.
+    single = dataclasses.replace(
+        plan, mesh={a: 1 for a in planner.MESH_AXES})
+    assert single.xla_overlap_flags("cpu") == {}
+
+
+def test_launcher_applies_overlap_flags_from_cmd(monkeypatch):
+    """launch.local scans the train command for a pinned plan and
+    pre-applies its flags to the (inherited) child XLA_FLAGS; an
+    explicit train.xla_overlap_flags=false in the command wins."""
+    from distributed_training_tpu.launch import local
+    monkeypatch.setenv("XLA_FLAGS", "")
+    applied = local.apply_overlap_flags_from_cmd(
+        ["-m", "distributed_training_tpu.train",
+         "train.sharding_plan=multichip_8dev"])
+    assert applied == [
+        "xla_cpu_enable_concurrency_optimized_scheduler"]
+    assert ("xla_cpu_enable_concurrency_optimized_scheduler"
+            in os.environ["XLA_FLAGS"])
+    # Every spelling the child's yaml config layer reads as False
+    # must disable the launcher too.
+    for tok in ("false", "False", "off", "no", "0"):
+        monkeypatch.setenv("XLA_FLAGS", "")
+        assert local.apply_overlap_flags_from_cmd(
+            ["train.sharding_plan=multichip_8dev",
+             f"train.xla_overlap_flags={tok}"]) == [], tok
+    # Repeated overrides: LAST wins, matching the child's config
+    # layer — false-then-true applies, true-then-false does not.
+    monkeypatch.setenv("XLA_FLAGS", "")
+    assert local.apply_overlap_flags_from_cmd(
+        ["train.sharding_plan=multichip_8dev",
+         "train.xla_overlap_flags=false",
+         "train.xla_overlap_flags=true"]) != []
+    monkeypatch.setenv("XLA_FLAGS", "")
+    assert local.apply_overlap_flags_from_cmd(
+        ["train.sharding_plan=multichip_8dev",
+         "train.xla_overlap_flags=true",
+         "train.xla_overlap_flags=false"]) == []
+    assert local.apply_overlap_flags_from_cmd(["-m", "x"]) == []
+    # A bad plan reference stays the child's loud failure.
+    assert local.apply_overlap_flags_from_cmd(
+        ["train.sharding_plan=no_such_plan"]) == []
+
+
+def test_planned_audit_target_carries_overlap_options():
+    """The overlap ratchet must score the schedule the flagged
+    consumers run: the planned target's compile options are exactly
+    the plan's cpu flag set."""
+    from distributed_training_tpu.analysis import targets
+    t = targets.TARGETS["multichip_r06_planned"]
+    plan = planner.load_plan("multichip_8dev")
+    assert dict(t.compiler_options) == plan.xla_overlap_flags("cpu")
+    assert t.min_overlap == 0.85
+
+
+# ---------------------------------------------------------------------------
+# Committed ledger artifacts
+# ---------------------------------------------------------------------------
+
+
+def test_multichip_r07_entry_provenance():
+    """The acceptance artifact: r07 measured on the same 8-device
+    {fsdp: 8} mesh as r06, faster, reshard-clean, with calibration
+    AND scheduler-flag provenance embedded."""
+    with open(os.path.join(REPO, "MULTICHIP_r07.json"),
+              encoding="utf-8") as f:
+        r07 = json.load(f)
+    with open(os.path.join(REPO, "MULTICHIP_r06.json"),
+              encoding="utf-8") as f:
+        r06 = json.load(f)
+    assert r07["dryrun"] is False
+    assert r07["mesh"] == r06["mesh"] == {"fsdp": 8}
+    assert r07["n_devices"] == 8
+    assert r07["spmd_reshard_warnings"] == 0
+    assert r07["step_time_ms"] < r06["step_time_ms"]
+    assert r07["tokens_per_sec"] > r06["tokens_per_sec"]
+    assert r07["compared_to"]["entry"] == "MULTICHIP_r06.json"
+    assert r07["compared_to"]["step_time_speedup"] > 1.0
+    # Calibration provenance: measured, matching the committed table.
+    assert r07["calibration"]["source"] == "measured"
+    assert r07["calibration"]["fingerprint"] == load_table(
+        CPU_TABLE_PATH).fingerprint()
+    # Scheduler provenance: the overlap flags were derived AND active.
+    fl = r07["xla_overlap_flags"]
+    assert fl["enabled"] is True
+    assert fl["active"] == fl["derived"] != {}
+    for name in fl["derived"]:
+        assert name in fl["xla_flags_env"]
