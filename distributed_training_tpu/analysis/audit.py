@@ -140,6 +140,45 @@ def _replicated_param_findings(target, trainer,
     return sorted(out, key=lambda f: -f["detail"]["bytes"])
 
 
+def _audit_serving_target(target) -> dict:
+    """Audit record for a ``kind="serving"`` target: the engine's
+    compiled decode program under the committed serving plan
+    (serving/disagg.py lowers it — the SAME helper the planner's
+    stage-2 serving verifier compiles, so the gated program is the
+    consumed program). SPMD003 does not apply (no trainer state);
+    SPMD001/002 come from the same parsers as the train targets."""
+    from distributed_training_tpu.parallel.planner import load_plan
+    from distributed_training_tpu.serving.disagg import (
+        compile_serving_hlo)
+    from distributed_training_tpu.telemetry import attribution
+    from distributed_training_tpu.telemetry import collectives
+
+    plan = load_plan(target.serving_plan)
+    text, warnings, mesh = compile_serving_hlo(plan, "decode")
+    coll = collectives.audit_hlo_text(text, mesh=mesh)
+    coll["mesh"] = dict(target.mesh_axes)
+    coll["spmd_reshard_warnings"] = len(warnings)
+    findings = (_reshard_findings(target, warnings)
+                + _unattributed_findings(target, coll))
+    by_code: dict[str, int] = {}
+    for f in findings:
+        by_code[f["code"]] = by_code.get(f["code"], 0) + 1
+    return {
+        "target": target.name,
+        "title": target.title,
+        "devices": target.devices,
+        "strategy": target.strategy,
+        "mesh": dict(target.mesh_axes),
+        "spmd_reshard_warnings": len(warnings),
+        "findings": findings,
+        "findings_by_code": by_code,
+        "collectives": collectives.summary_of_event(coll),
+        "overlap": attribution.overlap_summary(
+            attribution.hlo_overlap_report(text)),
+        "compiler_options": dict(target.compiler_options),
+    }
+
+
 def audit_target(target, min_replicated_bytes: int = 1 << 20) -> dict:
     """Compile one target and return its audit record (findings +
     collective summary + reshard-warning count)."""
@@ -148,6 +187,8 @@ def audit_target(target, min_replicated_bytes: int = 1 << 20) -> dict:
     from distributed_training_tpu.telemetry import attribution
     from distributed_training_tpu.telemetry import collectives
 
+    if getattr(target, "kind", "train") == "serving":
+        return _audit_serving_target(target)
     trainer, rt, batch = build_abstract_trainer(
         target.devices, target.strategy, target.model,
         dict(target.model_kwargs), target.batch_size, target.seq_len,

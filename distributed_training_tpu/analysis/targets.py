@@ -69,6 +69,15 @@ class AuditTarget:
     # consumers (cli/launch/bench) actually run — the audit and the
     # run must compile the same program.
     compiler_options: dict = field(default_factory=dict)
+    # Which program this target audits. "train" (default): the
+    # jitted train step via the abstract Trainer. "serving": the
+    # serving engine's compiled program under the committed plan
+    # named by ``serving_plan`` (serving/disagg.py lowers it — the
+    # decode objective's whole-batch one-token program). A KV-layout
+    # regression then goes tier-1 red with no accelerator, exactly
+    # like a train-step reshard.
+    kind: str = "train"
+    serving_plan: str = ""
     note: str = ""
 
 
@@ -195,7 +204,54 @@ def _register_planned_target() -> None:
     ))
 
 
+def _register_serving_decode_target() -> None:
+    """The committed serving DECODE plan's program as an audit
+    target: the paged-KV whole-batch decode step compiled under the
+    plan's layout (kv-head-sharded pool over tp). SPMD001 pinned to
+    zero — a paged-attention gather/scatter that starts replicating
+    the pool is the serving reshard cliff, and it must fail tier-1
+    without a chip. Same consume-the-plan-as-data discipline as the
+    planned train target."""
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))), "conf", "plans",
+        "serving_8dev_cpu_decode.json")
+    if not os.path.exists(path):
+        return
+    try:
+        with open(path, encoding="utf-8") as f:
+            plan = json.load(f)
+    except (OSError, ValueError):
+        # Same contract as the planned train target: a corrupt plan
+        # file must not kill the analysis import; the planner --check
+        # gate reports it loudly.
+        return
+    _register(AuditTarget(
+        name="serving_decode_planned",
+        title=f"serving paged-KV decode step "
+              f"(plan {plan['name']}@{plan['fingerprint']})",
+        devices=plan["devices"],
+        strategy=plan["base_strategy"],
+        model="transformer",
+        model_kwargs=dict(plan["inputs"]["model_kwargs"]),
+        batch_size=plan["batch_per_shard"],
+        seq_len=plan["seq_len"],
+        mesh_axes={a: s for a, s in plan["mesh"].items() if s > 1},
+        pin_zero=("SPMD001",),
+        kind="serving",
+        serving_plan=plan["name"],
+        note="The committed serving decode plan "
+             "(conf/plans/serving_8dev_cpu_decode.json) compiled "
+             "through the engine's real decode program "
+             "(serving/engine.py via serving/disagg.py) — "
+             "benchmarks/bench_serving.py measures this exact "
+             "layout. Zero SPMD001 pinned: the paged KV pool must "
+             "never compile into a replicating layout.",
+    ))
+
+
 _register_planned_target()
+_register_serving_decode_target()
 
 
 def resolve(names=None) -> list[AuditTarget]:

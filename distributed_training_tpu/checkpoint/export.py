@@ -62,7 +62,38 @@ def restore_step_local(ckpt_dir: str, step: int | None = None
     return state, int(step)
 
 
-def export(ckpt_dir: str, out_path: str, step: int | None = None) -> dict:
+def _plan_provenance(ckpt_dir: str, plan: str | None) -> dict | None:
+    """The ``sharding_plan`` stamp for the artifact meta: the source
+    run's plan NAME + FINGERPRINT, so a serving stack
+    (serving/disagg.py WeightStore) can refuse to lay these weights
+    out when the committed plan has been regenerated since export.
+
+    ``plan``: None → auto-detect from the run's resolved_config.yaml
+    (the directory above ``ckpt_dir``), absent/unpinned → no stamp
+    (legacy shape — loads with a warning downstream); "none" →
+    explicitly no stamp; anything else → that plan name/path."""
+    import yaml
+
+    name = plan
+    if name is None:
+        cfg_path = os.path.join(os.path.dirname(ckpt_dir),
+                                "resolved_config.yaml")
+        if not os.path.exists(cfg_path):
+            return None
+        with open(cfg_path) as f:
+            resolved = yaml.safe_load(f) or {}
+        name = (resolved.get("train") or {}).get("sharding_plan") or ""
+        if not name:
+            return None
+    if name == "none":
+        return None
+    from distributed_training_tpu.parallel.planner import load_plan
+    p = load_plan(name)
+    return {"name": p.name, "fingerprint": p.fingerprint()}
+
+
+def export(ckpt_dir: str, out_path: str, step: int | None = None,
+           plan: str | None = None) -> dict:
     import jax
 
     # Site customizations may pin the platform at interpreter start,
@@ -80,6 +111,9 @@ def export(ckpt_dir: str, out_path: str, step: int | None = None) -> dict:
         with open(meta_file) as f:
             meta = json.load(f) or {}
     meta.setdefault("step", int(step))
+    prov = _plan_provenance(ckpt_dir, plan)
+    if prov is not None:
+        meta["sharding_plan"] = prov
 
     from distributed_training_tpu.checkpoint.consolidate import (
         write_artifact,
@@ -96,8 +130,13 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--out", required=True, help="output .msgpack path")
     p.add_argument("--step", type=int, default=None,
                    help="checkpoint step (default: latest)")
+    p.add_argument("--plan", default=None,
+                   help="sharding-plan provenance to stamp into the "
+                        "artifact meta (default: auto-detect the "
+                        "run's train.sharding_plan; 'none' to skip)")
     args = p.parse_args(argv)
-    print(json.dumps(export(args.ckpt, args.out, args.step)))
+    print(json.dumps(export(args.ckpt, args.out, args.step,
+                            plan=args.plan)))
     return 0
 
 

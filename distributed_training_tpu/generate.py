@@ -101,6 +101,16 @@ def build_argparser() -> argparse.ArgumentParser:
     prompt.add_argument("--prompt-ids",
                         help="comma-separated token ids")
     p.add_argument("-n", "--max-new-tokens", type=int, default=64)
+    p.add_argument("--decode", choices=("paged", "fused"),
+                   default="paged",
+                   help="greedy decode path: 'paged' (default) runs "
+                        "the serving KV-cache decode step "
+                        "(serving/engine.py — token-for-token equal "
+                        "to the full-context path, pinned by test); "
+                        "'fused' keeps the model's dense-cache "
+                        "generate loop. Sampling (temperature > 0) "
+                        "always uses 'fused' for rng-stream "
+                        "stability.")
     p.add_argument("--temperature", type=float, default=0.0,
                    help="0 = greedy")
     p.add_argument("--top-k", type=int, default=0)
@@ -184,13 +194,43 @@ def main(argv: list[str] | None = None) -> int:
     if ids.size == 0:
         raise ValueError("empty prompt")
 
-    prompt = jnp.asarray(ids)[None, :]
-    rng = jax.random.PRNGKey(args.seed)
-    out = model.generate(params, prompt,
-                         max_new_tokens=args.max_new_tokens,
-                         temperature=args.temperature,
-                         top_k=args.top_k, rng=rng)
-    out_ids = np.asarray(out[0])
+    paged = (args.decode == "paged" and args.temperature <= 0
+             and hasattr(model, "prefill")
+             and getattr(model.cfg, "moe_num_experts", 0) == 0)
+    if paged:
+        # The serving decode path: a one-slot continuous-batching
+        # engine over the paged KV cache — each token reads only the
+        # cache, never the full context (the serving subsystem's
+        # step, reused; parity with the full-context argmax is
+        # pinned in tests/test_generate_cli.py).
+        from distributed_training_tpu.serving.engine import (
+            Engine, EngineConfig)
+        page = 16
+        total = int(ids.size) + args.max_new_tokens
+        # Pool capacity: pages for the whole request, capped at the
+        # model's window FLOORED to a page multiple (the cache
+        # requires it). A request that only fits the un-floored
+        # window takes the fused path below instead of failing.
+        model_cap = model.cfg.max_seq_len // page * page
+        max_len = min(-(-total // page) * page, model_cap)
+        if total > max_len:
+            paged = False
+        else:
+            eng = Engine(model, params, EngineConfig(
+                max_batch=1, page_size=page,
+                num_pages=-(-max_len // page) + 1,
+                max_seq_len=max_len,
+                prefill_chunk=min(64, max_len)))
+            out_ids = np.asarray(
+                eng.generate(ids, args.max_new_tokens), np.int32)
+    if not paged:
+        prompt = jnp.asarray(ids)[None, :]
+        rng = jax.random.PRNGKey(args.seed)
+        out = model.generate(params, prompt,
+                             max_new_tokens=args.max_new_tokens,
+                             temperature=args.temperature,
+                             top_k=args.top_k, rng=rng)
+        out_ids = np.asarray(out[0])
     print(f"# step={step} prompt_tokens={ids.size} "
           f"sampled={out_ids.size}", file=sys.stderr)
     if vocab == 256:

@@ -161,10 +161,35 @@ class PlanTarget:
     # Stage 2 budget: how many top-ranked candidates may be compiled
     # while hunting a reshard-clean winner before giving up.
     max_compiles: int = 4
+    # What the plan optimizes. "train": step throughput under the
+    # training memory model (params+grads+optimizer+activations) —
+    # the historical objective. "decode": serving decode LATENCY with
+    # HBM-FOR-KV feasibility (params + the paged KV pool must fit;
+    # score = decode steps/second, so a layout that all-gathers
+    # weights per token prices itself out) — serving/engine.py's
+    # whole-batch one-token program. "prefill": forward-only chunk
+    # THROUGHPUT (no grad/optimizer state, no backward collectives)
+    # — the engine's prompt side. The serving objectives fix remat to
+    # "none" (no backward to trade memory against) and exclude sp/pp
+    # (the decode/prefill programs have no sequence-parallel or
+    # pipelined form).
+    objective: str = "train"
     note: str = ""
 
+    def __post_init__(self):
+        if self.objective not in ("train", "decode", "prefill"):
+            raise PlanError(
+                f"unknown plan objective '{self.objective}' "
+                "(expected 'train', 'decode' or 'prefill')")
+
     def as_dict(self) -> dict:
-        return dataclasses.asdict(self)
+        d = dataclasses.asdict(self)
+        if self.objective == "train":
+            # Back-compat: committed train plans predate the
+            # objective field; their recorded inputs must keep
+            # matching this target's canonical form under --check.
+            d.pop("objective")
+        return d
 
 
 PLAN_TARGETS: dict[str, PlanTarget] = {}
@@ -210,6 +235,72 @@ _register(PlanTarget(
          "plan's recorded calibration fingerprint against the "
          "committed table, and benchmarks/bench_multichip.py "
          "--plan multichip_8dev_cpu measures it (MULTICHIP_r07).",
+))
+
+
+# The serving-plan model: the byte-vocab tiny transformer the serving
+# bench and tests decode (rope so position handling exercises the
+# per-row decode path; no MoE — the engine rejects it). One kwargs
+# dict shared by all three serving targets so prefill and decode plans
+# provably describe ONE model (the disaggregation contract).
+SERVING_MODEL_KWARGS = dict(vocab_size=256, d_model=64, n_heads=4,
+                            n_kv_heads=2, n_layers=2, max_seq_len=64,
+                            pos_encoding="rope", dtype="float32",
+                            param_dtype="float32")
+
+_register(PlanTarget(
+    name="serving_8dev_cpu_decode",
+    devices=8,
+    model_kwargs=dict(SERVING_MODEL_KWARGS),
+    seq_len=64,
+    optimizer="none",
+    chip="cpu",
+    # HBM-for-KV budget sized so a REPLICATED pool (tp=1) for 32
+    # slots x 64 positions does not fit but the kv-head-sharded
+    # (tp=2) pool does — the decode objective's whole point: the
+    # latency-optimal layout is forced by KV residency, exactly the
+    # 7B-scale story in miniature (docs/serving.md works the math).
+    hbm_gib=0.00095,
+    batch_candidates=(32,),
+    objective="decode",
+    note="The serving decode plan benchmarks/bench_serving.py lays "
+         "the engine out with (SERVING_r01): 32 decode slots, paged "
+         "KV pool head-sharded over tp. Audited reshard-clean by the "
+         "serving_decode_planned analysis target.",
+))
+
+_register(PlanTarget(
+    name="serving_4dev_cpu_prefill",
+    devices=4,
+    model_kwargs=dict(SERVING_MODEL_KWARGS),
+    seq_len=64,
+    optimizer="none",
+    chip="cpu",
+    hbm_gib=0.002,
+    batch_candidates=(1,),
+    objective="prefill",
+    note="Prefill-slice layout for the disaggregated pipeline "
+         "(serving/disagg.py): forward-only throughput objective "
+         "over half the 8-device CPU mesh; resolved against the SAME "
+         "model as serving_4dev_cpu_decode — two plans, one weight "
+         "store.",
+))
+
+_register(PlanTarget(
+    name="serving_4dev_cpu_decode",
+    devices=4,
+    model_kwargs=dict(SERVING_MODEL_KWARGS),
+    seq_len=64,
+    optimizer="none",
+    chip="cpu",
+    # Same HBM-for-KV squeeze as the 8-device decode target, at the
+    # 4-device slice's 16 slots: replicated pool out, tp=2 in.
+    hbm_gib=0.00065,
+    batch_candidates=(16,),
+    objective="decode",
+    note="Decode-slice layout for the disaggregated pipeline: the KV "
+         "cache written by the prefill slice is handed off onto this "
+         "layout (serving/disagg.py) and decode continues there.",
 ))
 
 
@@ -424,8 +515,17 @@ def enumerate_candidates(target: PlanTarget) -> list[Candidate]:
     impl = mk.get("attention_impl", "auto")
     seq_parallel = impl in ("ring", "ulysses")
 
+    serving = target.objective in ("decode", "prefill")
+    remat_cands = (("none",) if serving
+                   else tuple(target.remat_candidates))
+
     out: list[Candidate] = []
     for pp, dp, fsdp, sp, tp in _factorizations(target.devices, 5):
+        if serving and (pp > 1 or sp > 1):
+            # The serving decode/prefill programs have no pipelined
+            # or sequence-parallel form (engine.py) — such a mesh
+            # could not compile the program the plan is for.
+            continue
         if pp > 1 and (not target.allow_pp or n_layers % pp):
             continue
         if sp > 1 and (not seq_parallel or target.seq_len % sp):
@@ -435,7 +535,7 @@ def enumerate_candidates(target: PlanTarget) -> list[Candidate]:
         if impl == "ulysses" and sp > 1 and (
                 n_heads % (tp * sp) or n_kv % (tp * sp)):
             continue
-        for remat in target.remat_candidates:
+        for remat in remat_cands:
             for b in target.batch_candidates:
                 out.append(Candidate(pp, dp, fsdp, sp, tp, remat, b))
     return out
@@ -527,6 +627,8 @@ def score_candidate(target: PlanTarget, cand: Candidate,
 
     if calib == "auto":
         calib = resolve_calibration(target).table
+    if target.objective in ("decode", "prefill"):
+        return _score_serving(target, cand, n_params, calib)
     cfg = _tf_cfg(target, cand.remat)
     if n_params is None:
         n_params = _n_params(target)
@@ -622,6 +724,135 @@ def score_candidate(target: PlanTarget, cand: Candidate,
         calibrated=calib is not None,
         tokens_per_step=tokens,
         score=tokens / step_s if step_s > 0 else 0.0,
+    )
+    return rec
+
+
+def _score_serving(target: PlanTarget, cand: Candidate,
+                   n_params: int | None, calib) -> dict:
+    """Serving-objective scoring (objective "decode"/"prefill").
+
+    The training objective maximizes step THROUGHPUT under the
+    training memory model; serving wants something else entirely:
+
+    - **decode**: score = decode steps/second (LATENCY — one token
+      for the whole active batch per step), and feasibility is
+      HBM-FOR-KV: per-device params + the paged KV pool for
+      ``global_batch`` sequences of ``seq_len`` tokens must fit the
+      budget. The pool shards only over ``tp`` (kv heads —
+      serving/kv_cache.py's axis); ``fsdp`` shrinks resident params
+      but pays a FULL weight all-gather every decode step, which the
+      comms term prices — exactly the trade that makes tp the
+      latency-optimal decode layout once the replicated pool stops
+      fitting.
+    - **prefill**: forward-only chunk throughput — the train roofline
+      minus backward (no grad reduce-scatter, no optimizer state,
+      half the tp crossings), score = prompt tokens/second.
+
+    Both use the same calibrated collective/matmul curves as the
+    train objective (one cost model, three objectives).
+    """
+    from distributed_training_tpu.models.transformer import Transformer
+    from distributed_training_tpu.utils.metrics import (
+        peak_flops_per_chip)
+
+    cfg = _tf_cfg(target, "none")
+    if n_params is None:
+        n_params = _n_params(target)
+    pb = {"float32": 4, "bfloat16": 2, "float16": 2}[cfg.param_dtype]
+    ab = {"float32": 4, "bfloat16": 2, "float16": 2}[cfg.dtype]
+    S = target.seq_len
+    B_shard = cand.batch_per_shard
+    D = cfg.d_model
+    params_dev = n_params * pb / (cand.fsdp * cand.tp)
+    kv_tok = 2 * cfg.n_layers * cfg.n_kv_heads * cfg.head_dim * ab
+    budget = hbm_budget_gib(target) * 2**30
+
+    rec: dict = {
+        "candidate": cand.key,
+        "mesh": cand.mesh,
+        "remat": cand.remat,
+        "batch_per_shard": B_shard,
+        "hbm_budget_gib": round(hbm_budget_gib(target), 6),
+    }
+    if target.objective == "decode":
+        # Decode semantics (engine.py): the SLOT TABLE is replicated
+        # — batch_per_shard IS the concurrent-sequence count, on
+        # every device; only tp shards the per-token compute and the
+        # KV pool (kv heads). dp/fsdp neither shard slots nor speed a
+        # decode step up; fsdp shrinks RESIDENT params but pays a
+        # full weight all-gather per token, priced below.
+        slots = B_shard
+        kv_dev = slots * S * kv_tok / cand.tp
+        act_dev = slots * (4 * D + 2 * cfg.d_ff) * ab
+        total = params_dev + kv_dev + act_dev
+        rec["hbm_gib"] = round(total / 2**30, 6)
+        rec["kv_pool_gib"] = round(kv_dev / 2**30, 6)
+        rec["kv_capacity_tokens"] = int(
+            max(0.0, budget - params_dev - act_dev)
+            * cand.tp / kv_tok)
+        if total > budget:
+            rec.update(feasible=False, reason="hbm", score=0.0)
+            return rec
+        # Forward FLOPs for one token across the active batch
+        # (fwd ≈ 1/3 of the fwd+bwd accounting); tp is the only axis
+        # that divides them.
+        model = Transformer(cfg)
+        flops_step = (model.flops_per_token(S) / 3.0) * slots
+        flops_per_dev = flops_step / cand.tp
+        by_kind = {}
+        if cand.fsdp > 1:
+            by_kind["all-gather"] = n_params * ab
+        if cand.tp > 1:
+            by_kind["all-reduce"] = 2.0 * 2.0 * cfg.n_layers \
+                * slots * D * ab
+        tokens = slots  # one token per sequence per step
+    else:  # prefill
+        act_dev = B_shard * S * (4 * D + 2 * cfg.d_ff) * ab
+        total = params_dev + act_dev
+        rec["hbm_gib"] = round(total / 2**30, 6)
+        if total > budget:
+            rec.update(feasible=False, reason="hbm", score=0.0)
+            return rec
+        global_batch = B_shard * cand.dp * cand.fsdp
+        model = Transformer(cfg)
+        flops_step = (model.flops_per_token(S) / 3.0) * S \
+            * global_batch
+        flops_per_dev = flops_step / target.devices
+        by_kind = {}
+        if cand.fsdp > 1:
+            by_kind["all-gather"] = n_params * ab
+        if cand.tp > 1:
+            by_kind["all-reduce"] = 2.0 * 2.0 * cfg.n_layers \
+                * B_shard * S * D * ab
+        tokens = global_batch * S
+
+    if calib is not None:
+        compute_s = flops_per_dev / calib.achievable_flops_per_s(
+            flops_per_dev)
+    else:
+        compute_s = flops_per_dev / peak_flops_per_chip(target.chip)
+    if calib is not None:
+        comms_s = sum(calib.collective_seconds(k, b)
+                      for k, b in by_kind.items() if b > 0)
+    else:
+        comms_s = sum(by_kind.values()) \
+            / nominal_ici_bytes_per_s(target.chip)
+    step_s = max(compute_s, comms_s)
+    rec.update(
+        feasible=True,
+        reason="",
+        compute_s=compute_s,
+        comms_s=comms_s,
+        comms_bytes=int(sum(by_kind.values())),
+        comms_bytes_by_kind={k: int(b) for k, b in by_kind.items()
+                             if b > 0},
+        calibrated=calib is not None,
+        tokens_per_step=tokens,
+        # decode: steps/second (latency objective — batch size does
+        # not inflate it); prefill: tokens/second (throughput).
+        score=(1.0 / step_s if target.objective == "decode"
+               else tokens / step_s) if step_s > 0 else 0.0,
     )
     return rec
 
@@ -743,6 +974,20 @@ def model_kwargs_for(plan: Plan) -> dict:
 # ---------------------------------------------------------------------------
 
 
+def model_for_plan(plan: Plan):
+    """The Transformer a serving consumer builds for ``plan`` — the
+    target's model kwargs with the plan's remat decision dropped
+    (serving programs have no backward; remat keys would be rejected
+    by TransformerConfig). One constructor for the engine builder,
+    the HTTP server, the disagg pipeline, and the serving verifier."""
+    from distributed_training_tpu.models.transformer import (
+        Transformer, TransformerConfig)
+    mk = model_kwargs_for(plan)
+    mk.pop("remat", None)
+    mk.pop("remat_policy", None)
+    return Transformer(TransformerConfig(**mk))
+
+
 def compile_verify(target: PlanTarget, plan: Plan) -> dict:
     """Compile the REAL train step against this plan on a simulated
     mesh (``analysis/compile.py``) and return the evidence: the SPMD
@@ -791,6 +1036,18 @@ def compile_verify(target: PlanTarget, plan: Plan) -> dict:
     }
 
 
+def verify_fn_for(target: PlanTarget) -> Callable:
+    """The stage-2 verifier matching the target's objective: the
+    train step for "train" plans, the serving engine's compiled
+    decode/prefill program for serving plans (serving/disagg.py) —
+    in every case the verification path IS the consumption path."""
+    if target.objective == "train":
+        return compile_verify
+    from distributed_training_tpu.serving.disagg import (
+        compile_verify_serving)
+    return compile_verify_serving
+
+
 def plan_search(target: PlanTarget,
                 verify_fn: Callable | None = None) -> Plan:
     """The full search: rank analytically, then walk candidates
@@ -801,7 +1058,7 @@ def plan_search(target: PlanTarget,
     budget (``target.max_compiles``) runs out with every compiled
     candidate dirty — a planner that silently shipped a resharding
     layout would defeat its own reason to exist."""
-    verify = verify_fn or compile_verify
+    verify = verify_fn or verify_fn_for(target)
     lookup = resolve_calibration(target)
     calib, calib_note = lookup.table, lookup.note
     if lookup.status == "unusable":
@@ -1092,7 +1349,7 @@ def check_plan(target: PlanTarget,
             f"{target.name}: committed plan carries no clean compile "
             "evidence — re-run planner --write")
     if compile_winner and not problems:
-        fresh = compile_verify(target, rebuilt)
+        fresh = verify_fn_for(target)(target, rebuilt)
         if fresh["spmd_reshard_warnings"]:
             problems.append(
                 f"{target.name}: plan is no longer reshard-clean on "

@@ -1,0 +1,30 @@
+"""Serving subsystem: continuous batching over a paged, sharded KV cache.
+
+The inference half of the production story (ROADMAP item 1). Pieces:
+
+- ``kv_cache.py``  — the paged KV pool: fixed-size pages in one
+  preallocated reservation, per-sequence page tables, host-side
+  allocator with telemetry-accounted occupancy;
+- ``engine.py``    — the continuous-batching engine: admission queue
+  feeding two jitted programs (chunked prefill, whole-batch decode),
+  per-step join/evict with zero recompiles after warmup;
+- ``disagg.py``    — prefill/decode disaggregation: two planner-derived
+  layouts resolved against ONE weight store, KV handed off between
+  mesh slices;
+- ``server.py``    — stdlib HTTP generate endpoint + live serving
+  gauges on the telemetry metrics endpoint.
+
+Benchmark: ``benchmarks/bench_serving.py`` (Poisson load, TTFT/latency
+percentiles, goodput under a mid-storm preemption) → SERVING ledger.
+Docs: docs/serving.md.
+"""
+
+from distributed_training_tpu.serving.engine import (  # noqa: F401
+    Engine,
+    EngineConfig,
+    Request,
+)
+from distributed_training_tpu.serving.kv_cache import (  # noqa: F401
+    PagedCacheConfig,
+    PagedKVCache,
+)

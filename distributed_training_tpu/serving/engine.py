@@ -1,0 +1,762 @@
+"""Continuous-batching engine: admission queue → two jitted programs.
+
+The serving hot loop. Requests join and leave the running batch at
+every step (continuous batching — no head-of-line blocking behind the
+longest sequence in a static batch), against exactly THREE compiled
+programs whose shapes never change:
+
+- **prefill, first chunk** — the prompt's first ``prefill_chunk``
+  tokens as ordinary causal self-attention (flash-eligible on TPU via
+  ops.attention), KV written into the sequence's pages;
+- **prefill, continuation chunk** — later chunks attend the pages
+  written so far plus themselves (ops/paged_attention.py chunk form);
+- **decode** — ONE token for the whole slot table (max_batch wide)
+  against the paged pool, inactive slots masked and their writes
+  pointed at the scratch page.
+
+Join/evict therefore never change a traced shape: admission fills a
+slot and allocates pages; completion frees them; the programs compile
+once at warmup and never again (``compile_counts`` exposes the jit
+cache sizes so the bench can ASSERT zero recompiles mid-storm).
+
+Scheduling policy (``EngineConfig.policy``):
+
+- ``"prefill"`` (default): pending prompt work runs before decode —
+  lowest TTFT, decode tokens stall behind prompt storms;
+- ``"decode"``: the active batch decodes first; prompts admit only
+  when no sequence can decode — best per-token latency, TTFT suffers.
+
+``prefill_chunk`` is the per-step prefill token budget (one chunk per
+step); decode emits up to ``max_batch`` tokens per step.
+
+Sampling is greedy at ``temperature == 0`` (the parity-tested path —
+token-for-token equal to full-context argmax); ``temperature > 0``
+samples per-slot from a per-step folded key. Batch-composition
+independence (a sequence's tokens don't depend on who shares the
+batch) is exact for greedy decoding and pinned by test.
+
+MoE models are rejected at construction: expert dispatch has no
+serving decode path yet.
+"""
+
+from __future__ import annotations
+
+import collections
+import time
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from distributed_training_tpu.serving.kv_cache import (
+    PagedCacheConfig,
+    PagedKVCache,
+)
+from distributed_training_tpu.telemetry import event
+
+_STACKED = ("ln1", "ln2", "attn", "mlp")
+
+
+@dataclass(frozen=True)
+class EngineConfig:
+    """Engine knobs (mirrored by ``conf/serving/default.yaml``)."""
+
+    max_batch: int = 8            # decode slot count
+    page_size: int = 16
+    num_pages: int = 128
+    max_seq_len: int = 256        # per-sequence cap (prompt + new)
+    prefill_chunk: int = 32       # tokens per prefill step
+    policy: str = "prefill"       # "prefill" | "decode" priority
+    temperature: float = 0.0
+    top_k: int = 0
+    seed: int = 0
+    kv_axis: str = "tp"           # pool kv-head shard axis
+    paged_impl: str = "auto"      # ops/paged_attention dispatch
+
+    def __post_init__(self):
+        if self.policy not in ("prefill", "decode"):
+            raise ValueError(
+                f"unknown scheduling policy '{self.policy}' "
+                "(expected 'prefill' or 'decode')")
+        if self.prefill_chunk < 1:
+            raise ValueError("prefill_chunk must be >= 1")
+        if self.max_batch < 1:
+            raise ValueError("max_batch must be >= 1")
+
+
+@dataclass
+class Request:
+    """One generation request. ``arrival`` defaults to submit time."""
+
+    id: str
+    prompt: np.ndarray
+    max_new_tokens: int
+    arrival: float | None = None
+
+
+@dataclass
+class _Seq:
+    req: Request
+    slot: int
+    prefilled: int = 0            # prompt tokens consumed so far
+    generated: list = field(default_factory=list)
+    first_token_t: float | None = None
+    token_times: list = field(default_factory=list)
+
+    @property
+    def prompt_len(self) -> int:
+        return int(self.req.prompt.shape[0])
+
+    @property
+    def prefill_done(self) -> bool:
+        return self.prefilled >= self.prompt_len
+
+    @property
+    def done(self) -> bool:
+        return len(self.generated) >= self.req.max_new_tokens
+
+
+def _rope_bhd(x, positions):
+    """RoPE on (B, H, hd) with per-row absolute positions (B,) —
+    the same freqs/rotation as models.transformer._rope (parity with
+    the training stack is load-bearing: drift here is silent output
+    corruption, caught by the paged⇄dense test)."""
+    import jax.numpy as jnp
+
+    D = x.shape[-1]
+    half = D // 2
+    freqs = 1.0 / (10000 ** (jnp.arange(half, dtype=jnp.float32)
+                             / half))
+    angles = positions[:, None].astype(jnp.float32) * freqs[None, :]
+    cos = jnp.cos(angles)[:, None, :]
+    sin = jnp.sin(angles)[:, None, :]
+    x1, x2 = x[..., :half], x[..., half:]
+    return jnp.concatenate([x1 * cos - x2 * sin,
+                            x1 * sin + x2 * cos],
+                           axis=-1).astype(x.dtype)
+
+
+def _layer_norm(x, scale, bias):
+    import jax
+    import jax.numpy as jnp
+
+    dtype = x.dtype
+    x = x.astype(jnp.float32)
+    mu = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x - mu), axis=-1, keepdims=True)
+    y = (x - mu) * jax.lax.rsqrt(var + 1e-5)
+    return (y * scale + bias).astype(dtype)
+
+
+class Engine:
+    """The continuous-batching engine over one model + weight set.
+
+    ``params`` should already be placed (serving/disagg.py
+    ``place_params`` for a planned layout); ``mesh`` shards the KV
+    pool's kv-head axis over ``cfg.kv_axis`` when that axis has
+    extent > 1. ``telemetry`` rides the ambient sink
+    (telemetry/events.py) — every step emits a ``serving`` record the
+    metrics endpoint folds into the ``dtt_serving_*`` gauges.
+    """
+
+    def __init__(self, model, params, cfg: EngineConfig,
+                 mesh=None):
+        import jax
+
+        if getattr(model.cfg, "moe_num_experts", 0) > 0:
+            raise ValueError(
+                "serving engine has no MoE decode path (expert "
+                "dispatch per single token is unimplemented)")
+        if cfg.max_seq_len > model.cfg.max_seq_len:
+            raise ValueError(
+                f"engine max_seq_len ({cfg.max_seq_len}) exceeds the "
+                f"model's ({model.cfg.max_seq_len})")
+        self.model = model
+        self.cfg = cfg
+        self.mesh = mesh
+        self.params = params
+        self.cache = PagedKVCache(
+            PagedCacheConfig(
+                n_layers=model.cfg.n_layers,
+                n_kv_heads=model.cfg.n_kv_heads,
+                head_dim=model.cfg.head_dim,
+                page_size=cfg.page_size,
+                num_pages=cfg.num_pages,
+                max_seq_len=cfg.max_seq_len,
+                dtype=model.cfg.dtype),
+            mesh=mesh, kv_axis=cfg.kv_axis)
+        self.queue: collections.deque[Request] = collections.deque()
+        self.slots: list[_Seq | None] = [None] * cfg.max_batch
+        self.completed: list[dict] = []
+        self._step_counter = 0
+        self._base_rng = jax.random.PRNGKey(cfg.seed)
+        self._build_programs()
+
+    # -- jitted programs ---------------------------------------------------
+
+    def _build_programs(self) -> None:
+        import functools
+
+        import jax
+
+        c = self.model.cfg
+        # Donate the pools: the decode/prefill programs functionally
+        # update arrays that dominate serving HBM — without donation
+        # every step would hold two live copies of the whole pool.
+        self._decode_fn = jax.jit(
+            functools.partial(_decode_program, cfg=c,
+                              temperature=self.cfg.temperature,
+                              top_k=self.cfg.top_k,
+                              paged_impl=self.cfg.paged_impl),
+            donate_argnums=(1, 2))
+        self._prefill_first_fn = jax.jit(
+            functools.partial(_prefill_program, cfg=c, first=True,
+                              paged_impl=self.cfg.paged_impl),
+            donate_argnums=(1, 2))
+        self._prefill_cont_fn = jax.jit(
+            functools.partial(_prefill_program, cfg=c, first=False,
+                              paged_impl=self.cfg.paged_impl),
+            donate_argnums=(1, 2))
+
+    def compile_counts(self) -> dict:
+        """Jit-cache sizes per program — the bench's zero-recompile
+        assertion compares this dict before/after the storm."""
+        return {
+            "decode": self._decode_fn._cache_size(),
+            "prefill_first": self._prefill_first_fn._cache_size(),
+            "prefill_cont": self._prefill_cont_fn._cache_size(),
+        }
+
+    def warmup(self) -> dict:
+        """Compile all three programs against scratch-only page rows
+        (zero allocator side effects: every write lands in the
+        scratch page). Returns compile_counts()."""
+        import jax.numpy as jnp
+
+        B, P = self.cfg.max_batch, self.cache.cfg.pages_per_seq
+        C = self.cfg.prefill_chunk
+        zrows = jnp.zeros((B, P), jnp.int32)
+        toks = jnp.zeros((B,), jnp.int32)
+        pos = jnp.zeros((B,), jnp.int32)
+        act = jnp.zeros((B,), jnp.bool_)
+        rng = jnp.zeros((2,), jnp.uint32)
+        _t, k, v = self._decode_fn(self.params, self.cache.k_pages,
+                                   self.cache.v_pages, toks, pos,
+                                   zrows, act, rng)
+        self.cache.update_pools(k, v)
+        ctoks = jnp.zeros((1, C), jnp.int32)
+        row = jnp.zeros((P,), jnp.int32)
+        for fn in (self._prefill_first_fn, self._prefill_cont_fn):
+            _lg, k, v = fn(self.params, self.cache.k_pages,
+                           self.cache.v_pages, ctoks,
+                           jnp.int32(0), jnp.int32(1), row)
+            self.cache.update_pools(k, v)
+        return self.compile_counts()
+
+    # -- admission ---------------------------------------------------------
+
+    def _validate(self, req: Request) -> None:
+        if req.prompt.shape[0] == 0:
+            raise ValueError(f"request {req.id}: empty prompt")
+        if req.max_new_tokens < 1:
+            raise ValueError(
+                f"request {req.id}: max_new_tokens must be >= 1")
+        total = req.prompt.shape[0] + req.max_new_tokens
+        if total > self.cfg.max_seq_len:
+            raise ValueError(
+                f"request {req.id}: prompt ({req.prompt.shape[0]}) + "
+                f"max_new_tokens ({req.max_new_tokens}) exceeds "
+                f"max_seq_len ({self.cfg.max_seq_len})")
+
+    def submit(self, req: Request) -> None:
+        if req.arrival is None:
+            req.arrival = time.monotonic()
+        self._validate(req)
+        self.queue.append(req)
+
+    @property
+    def in_flight(self) -> int:
+        return sum(1 for s in self.slots if s is not None)
+
+    @property
+    def idle(self) -> bool:
+        return not self.queue and self.in_flight == 0
+
+    def _free_slot(self) -> int | None:
+        for i, s in enumerate(self.slots):
+            if s is None:
+                return i
+        return None
+
+    def _admit(self) -> _Seq | None:
+        """Move the head-of-queue request into a slot, pages for its
+        FIRST chunk allocated. None when no slot/pages are free
+        (backpressure — the request stays queued)."""
+        if not self.queue:
+            return None
+        slot = self._free_slot()
+        if slot is None:
+            return None
+        req = self.queue[0]
+        first = min(req.prompt.shape[0], self.cfg.prefill_chunk)
+        if not self.cache.can_admit(first):
+            return None
+        self.queue.popleft()
+        self.cache.join(req.id)
+        self.cache.ensure(req.id, first)
+        seq = _Seq(req=req, slot=slot)
+        self.slots[slot] = seq
+        return seq
+
+    # -- step --------------------------------------------------------------
+
+    def _prefill_candidates(self) -> list[_Seq]:
+        return [s for s in self.slots
+                if s is not None and not s.prefill_done]
+
+    def _decode_candidates(self) -> list[_Seq]:
+        return [s for s in self.slots
+                if s is not None and s.prefill_done and not s.done]
+
+    def step(self) -> dict:
+        """One scheduling decision + one compiled program launch.
+        Returns a record of what ran (``kind``: prefill/decode/idle).
+        """
+        t0 = time.monotonic()
+        pending = self._prefill_candidates()
+        can_admit = (self.queue and self._free_slot() is not None)
+        want_prefill = bool(pending or can_admit)
+        decodable = self._decode_candidates()
+        if self.cfg.policy == "prefill":
+            kind = "prefill" if want_prefill else (
+                "decode" if decodable else "idle")
+        else:
+            kind = "decode" if decodable else (
+                "prefill" if want_prefill else "idle")
+        tokens_out = 0
+        if kind == "prefill":
+            seq = pending[0] if pending else self._admit()
+            # Backpressure fallback: when admission OR a mid-prompt
+            # page allocation fails (pool exhausted), decode instead
+            # — decoding sequences finish and free the pages the
+            # prefill is waiting for. Without the second fallback a
+            # prefill-priority engine livelocks: step() would pick
+            # the stalled prefill forever and decode would never run
+            # (regression-pinned in tests/test_serving.py).
+            if seq is None or not self._run_prefill_chunk(seq):
+                kind = "decode" if decodable else "idle"
+        if kind == "decode":
+            tokens_out = self._run_decode(decodable)
+        dur = time.monotonic() - t0
+        # "op", not "kind": telemetry's record envelope owns "kind"
+        # (the event name), and a colliding field would silently
+        # relabel the whole record past the metrics observer.
+        rec = {"op": kind, "dur_s": dur, "tokens": tokens_out,
+               "in_flight": self.in_flight,
+               "queue_depth": len(self.queue),
+               **self.cache.occupancy()}
+        event("serving", **rec)
+        self._step_counter += 1
+        return rec
+
+    def _run_prefill_chunk(self, seq: _Seq) -> bool:
+        """One chunk of ``seq``'s prompt. False = no progress (the
+        pool could not cover the chunk's pages — backpressure; the
+        caller must let decode run so pages free up)."""
+        import jax.numpy as jnp
+
+        c = self.cfg
+        start = seq.prefilled
+        n_valid = min(c.prefill_chunk, seq.prompt_len - start)
+        if not self.cache.ensure(seq.req.id, start + n_valid):
+            return False
+        chunk = np.zeros((1, c.prefill_chunk), np.int32)
+        chunk[0, :n_valid] = seq.req.prompt[start:start + n_valid]
+        row = jnp.asarray(self.cache.page_row(seq.req.id))
+        fn = (self._prefill_first_fn if start == 0
+              else self._prefill_cont_fn)
+        logits, k, v = fn(self.params, self.cache.k_pages,
+                          self.cache.v_pages, jnp.asarray(chunk),
+                          jnp.int32(start), jnp.int32(n_valid), row)
+        self.cache.update_pools(k, v)
+        self.cache.advance(seq.req.id, n_valid)
+        seq.prefilled = start + n_valid
+        if seq.prefill_done:
+            tok = self._sample_host(logits)
+            now = time.monotonic()
+            seq.first_token_t = now
+            seq.token_times.append(now)
+            seq.generated.append(tok)
+            self._maybe_finish(seq)
+        return True
+
+    def _sample_host(self, logits) -> int:
+        """Sample the prefill's first token on host — one token per
+        request lifetime; the decode program samples the rest
+        in-compiled."""
+        import jax
+        import jax.numpy as jnp
+
+        if self.cfg.temperature <= 0:
+            return int(jnp.argmax(logits))
+        rng = jax.random.fold_in(self._base_rng,
+                                 1_000_000 + self._step_counter)
+        lg = logits / self.cfg.temperature
+        if self.cfg.top_k:
+            kth = jax.lax.top_k(lg, self.cfg.top_k)[0][-1]
+            lg = jnp.where(lg < kth, -jnp.inf, lg)
+        return int(jax.random.categorical(rng, lg))
+
+    def _run_decode(self, decodable: list[_Seq]) -> int:
+        import jax
+        import jax.numpy as jnp
+
+        B = self.cfg.max_batch
+        tokens = np.zeros((B,), np.int32)
+        positions = np.zeros((B,), np.int32)
+        active = np.zeros((B,), bool)
+        seq_ids: list = [None] * B
+        stepped: list[_Seq] = []
+        for s in decodable:
+            # The new token's KV lands at position length(seq); make
+            # sure a page covers it. Failure = pool exhausted: the
+            # slot stalls this step and resumes when pages free.
+            if not self.cache.ensure(s.req.id,
+                                     self.cache.length(s.req.id) + 1):
+                continue
+            b = s.slot
+            tokens[b] = s.generated[-1]
+            positions[b] = self.cache.length(s.req.id)
+            active[b] = True
+            seq_ids[b] = s.req.id
+            stepped.append(s)
+        if not stepped:
+            return 0
+        rows = self.cache.page_rows(seq_ids)
+        rng = jax.random.fold_in(self._base_rng, self._step_counter)
+        nxt, k, v = self._decode_fn(
+            self.params, self.cache.k_pages, self.cache.v_pages,
+            jnp.asarray(tokens), jnp.asarray(positions),
+            jnp.asarray(rows), jnp.asarray(active),
+            jax.random.key_data(rng))
+        self.cache.update_pools(k, v)
+        nxt = np.asarray(nxt)
+        now = time.monotonic()
+        for s in stepped:
+            self.cache.advance(s.req.id, 1)
+            s.generated.append(int(nxt[s.slot]))
+            if s.first_token_t is None:
+                s.first_token_t = now
+            s.token_times.append(now)
+            self._maybe_finish(s)
+        return len(stepped)
+
+    def _maybe_finish(self, seq: _Seq) -> None:
+        if not seq.done:
+            return
+        self.cache.free(seq.req.id)
+        self.slots[seq.slot] = None
+        now = time.monotonic()
+        arrival = seq.req.arrival if seq.req.arrival is not None \
+            else now
+        gaps = [b - a for a, b in zip(seq.token_times,
+                                      seq.token_times[1:])]
+        rec = {
+            "id": seq.req.id,
+            "prompt_tokens": seq.prompt_len,
+            "new_tokens": len(seq.generated),
+            "tokens": list(seq.generated),
+            "ttft_s": (seq.first_token_t - arrival
+                       if seq.first_token_t is not None else None),
+            "latency_s": now - arrival,
+            "token_gaps_s": gaps,
+        }
+        self.completed.append(rec)
+        event("serving_request",
+              **{k: rec[k] for k in ("id", "prompt_tokens",
+                                     "new_tokens", "ttft_s",
+                                     "latency_s")})
+
+    # -- convenience -------------------------------------------------------
+
+    def run_until_drained(self, max_steps: int = 100_000) -> int:
+        """Step until queue + slots are empty. Returns steps taken."""
+        n = 0
+        while not self.idle and n < max_steps:
+            self.step()
+            n += 1
+        if not self.idle:
+            raise RuntimeError(
+                f"engine not drained after {max_steps} steps "
+                f"(queue={len(self.queue)}, in_flight="
+                f"{self.in_flight})")
+        return n
+
+    def generate(self, prompt: np.ndarray, max_new_tokens: int
+                 ) -> list[int]:
+        """One prompt through the full continuous-batching path
+        (the generate-CLI route). Returns the generated token ids."""
+        rid = f"gen-{self._step_counter}-{len(self.completed)}"
+        self.submit(Request(id=rid,
+                            prompt=np.asarray(prompt, np.int32),
+                            max_new_tokens=max_new_tokens))
+        self.run_until_drained()
+        rec = next(r for r in reversed(self.completed)
+                   if r["id"] == rid)
+        return rec["tokens"]
+
+    def adopt(self, req: Request, first_token: int,
+              k_dense: np.ndarray, v_dense: np.ndarray) -> None:
+        """Adopt an EXTERNALLY-PREFILLED sequence (the disaggregation
+        handoff, serving/disagg.py): its prompt KV arrives as dense
+        (L, Hkv, prompt_len, hd) arrays and is written into this
+        engine's pages; decode continues here as if the prefill had
+        run locally. ``first_token`` is the token the prefill slice
+        sampled from its final logits."""
+        from distributed_training_tpu.serving.disagg import import_kv
+
+        if req.arrival is None:
+            req.arrival = time.monotonic()
+        self._validate(req)
+        slot = self._free_slot()
+        if slot is None:
+            raise RuntimeError("no free slot to adopt into")
+        self.cache.join(req.id)
+        try:
+            import_kv(self.cache, req.id, k_dense, v_dense)
+        except Exception:
+            # A failed import must not leak the joined table entry
+            # (a retry of the same request id would hit "already
+            # joined" forever).
+            self.cache.free(req.id)
+            raise
+        seq = _Seq(req=req, slot=slot, prefilled=req.prompt.shape[0])
+        now = time.monotonic()
+        seq.first_token_t = now
+        seq.token_times.append(now)
+        seq.generated.append(int(first_token))
+        self.slots[slot] = seq
+        self._maybe_finish(seq)
+
+    def preempt(self) -> list[Request]:
+        """Simulated engine preemption: drop all device-side progress,
+        free every page, and hand back the unfinished work (queued +
+        in-flight requests, fresh — generation restarts from the
+        prompt, the standard continuous-batching recovery). The
+        engine is reusable afterwards (a restarted incarnation calls
+        ``submit`` with these)."""
+        lost: list[Request] = []
+        for i, s in enumerate(self.slots):
+            if s is None:
+                continue
+            self.cache.free(s.req.id)
+            self.slots[i] = None
+            lost.append(Request(id=s.req.id, prompt=s.req.prompt,
+                                max_new_tokens=s.req.max_new_tokens,
+                                arrival=s.req.arrival))
+        lost.extend(self.queue)
+        self.queue.clear()
+        event("serving_preempt", lost=len(lost))
+        return lost
+
+
+# ---------------------------------------------------------------------------
+# The compiled programs (pure functions of arrays + static model cfg)
+# ---------------------------------------------------------------------------
+
+
+def _write_kv(k_pages, v_pages, k_new, v_new, page_ids, offsets):
+    """Scatter per-row new KV into the layer's pool.
+
+    k_pages/v_pages (Hkv, N, ps, hd); k_new/v_new (B, Hkv, hd);
+    page_ids/offsets (B,) int32 — rows whose write must be dead point
+    at the scratch page (id 0). Live rows never share a (page, slot)
+    pair (pages are owned by exactly one sequence), so scatter order
+    is immaterial; scratch-page collisions write garbage over
+    garbage."""
+    kT = k_new.transpose(1, 0, 2)          # (Hkv, B, hd)
+    vT = v_new.transpose(1, 0, 2)
+    k_pages = k_pages.at[:, page_ids, offsets].set(kT)
+    v_pages = v_pages.at[:, page_ids, offsets].set(vT)
+    return k_pages, v_pages
+
+
+def _decode_program(params, k_pages, v_pages, tokens, positions,
+                    page_tables, active, rng_data, *, cfg,
+                    temperature, top_k, paged_impl):
+    """One token for the whole slot table.
+
+    tokens (B,) int32 — last sampled token per slot; positions (B,)
+    — the ABSOLUTE position that token occupies (== kv entries
+    already written); page_tables (B, P); active (B,) bool. Returns
+    (next_tokens (B,), k_pages, v_pages). Inactive slots compute
+    garbage into the scratch page and their sampled token is 0.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_training_tpu.ops.paged_attention import (
+        paged_attention)
+
+    dt = jnp.dtype(cfg.dtype)
+    B = tokens.shape[0]
+    ps = k_pages.shape[3]
+    x = params["tok_embed"][tokens].astype(dt)            # (B, D)
+    if cfg.pos_encoding == "learned":
+        x = x + params["pos_embed"][positions].astype(dt)
+    # Dead writes → scratch page 0, offset 0.
+    page_ids = jnp.where(
+        active,
+        jnp.take_along_axis(page_tables,
+                            (positions // ps)[:, None],
+                            axis=1)[:, 0],
+        0).astype(jnp.int32)
+    offsets = jnp.where(active, positions % ps, 0).astype(jnp.int32)
+    lengths = jnp.where(active, positions + 1, 0).astype(jnp.int32)
+    stacked = {k: params[k] for k in _STACKED}
+
+    def layer_body(x, inp):
+        layer, kp, vp = inp
+        h = _layer_norm(x, layer["ln1"]["scale"],
+                        layer["ln1"]["bias"])
+        q = jnp.einsum("bd,dhk->bhk", h,
+                       layer["attn"]["wq"].astype(dt))
+        k = jnp.einsum("bd,dhk->bhk", h,
+                       layer["attn"]["wk"].astype(dt))
+        v = jnp.einsum("bd,dhk->bhk", h,
+                       layer["attn"]["wv"].astype(dt))
+        if cfg.pos_encoding == "rope":
+            q = _rope_bhd(q, positions)
+            k = _rope_bhd(k, positions)
+        kp, vp = _write_kv(kp, vp, k.astype(kp.dtype),
+                           v.astype(vp.dtype), page_ids, offsets)
+        attn = paged_attention(q, kp, vp, lengths, page_tables,
+                               impl=paged_impl)
+        x = x + jnp.einsum("bhk,hkd->bd", attn,
+                           layer["attn"]["wo"].astype(dt))
+        h = _layer_norm(x, layer["ln2"]["scale"],
+                        layer["ln2"]["bias"])
+        m = layer["mlp"]
+        u = jax.nn.gelu(jnp.einsum("bd,df->bf", h,
+                                   m["wi"].astype(dt))
+                        + m["bi"].astype(dt))
+        x = x + (jnp.einsum("bf,fd->bd", u, m["wo"].astype(dt))
+                 + m["bo"].astype(dt))
+        return x, (kp, vp)
+
+    x, (k_pages, v_pages) = jax.lax.scan(
+        layer_body, x, (stacked, k_pages, v_pages))
+    x = _layer_norm(x, params["final_norm"]["scale"],
+                    params["final_norm"]["bias"])
+    head = (params["tok_embed"].T if cfg.tie_embeddings
+            else params["lm_head"])
+    logits = jnp.einsum("bd,dv->bv", x,
+                        head.astype(dt)).astype(jnp.float32)
+    if temperature <= 0:
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    else:
+        lg = logits / temperature
+        if top_k:
+            kth = jax.lax.top_k(lg, top_k)[0][..., -1:]
+            lg = jnp.where(lg < kth, -jnp.inf, lg)
+        keys = jax.random.split(
+            jax.random.wrap_key_data(rng_data), B)
+        nxt = jax.vmap(jax.random.categorical)(keys, lg).astype(
+            jnp.int32)
+    return jnp.where(active, nxt, 0), k_pages, v_pages
+
+
+def _prefill_program(params, k_pages, v_pages, chunk_tokens,
+                     start_pos, n_valid, page_row, *, cfg, first,
+                     paged_impl):
+    """One prompt chunk for one sequence.
+
+    chunk_tokens (1, C) int32 (positions >= n_valid are padding);
+    start_pos — the chunk's first absolute position; page_row (P,) —
+    the sequence's table. Writes the chunk's KV into its pages and
+    returns (next-token logits (V,) fp32 — from the LAST VALID
+    position, meaningful when this is the prompt's final chunk —
+    k_pages, v_pages).
+
+    ``first=True`` (start_pos == 0, traced as a separate program):
+    attention is ordinary causal self-attention over the chunk
+    (ops.attention — the flash path on TPU). Continuation chunks
+    attend the pages written so far plus themselves via the paged
+    chunk form. Both write-then-read the pool identically, so the
+    two programs' caches are interchangeable token-for-token.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_training_tpu.ops.attention import (
+        dot_product_attention)
+    from distributed_training_tpu.ops.paged_attention import (
+        paged_attention_chunk)
+
+    del paged_impl  # chunk form has no kernel path yet
+    dt = jnp.dtype(cfg.dtype)
+    C = chunk_tokens.shape[1]
+    ps = k_pages.shape[3]
+    idx = jnp.arange(C, dtype=jnp.int32)
+    abs_pos = start_pos + idx                             # (C,)
+    valid = idx < n_valid
+    x = params["tok_embed"][chunk_tokens[0]].astype(dt)   # (C, D)
+    if cfg.pos_encoding == "learned":
+        # Clamp padding positions into range; their rows are dead.
+        safe = jnp.minimum(abs_pos, cfg.max_seq_len - 1)
+        x = x + params["pos_embed"][safe].astype(dt)
+    page_ids = jnp.where(valid, page_row[abs_pos // ps], 0)
+    offsets = jnp.where(valid, abs_pos % ps, 0)
+    # Padding queries mask out of the paged form via negative
+    # positions; the causal first-chunk form never lets a valid query
+    # see a padding key (pads sit at higher positions).
+    q_pos = jnp.where(valid, abs_pos, -1)[None, :]        # (1, C)
+    stacked = {k: params[k] for k in _STACKED}
+
+    def layer_body(x, inp):
+        layer, kp, vp = inp
+        h = _layer_norm(x, layer["ln1"]["scale"],
+                        layer["ln1"]["bias"])
+        q = jnp.einsum("cd,dhk->chk", h,
+                       layer["attn"]["wq"].astype(dt))
+        k = jnp.einsum("cd,dhk->chk", h,
+                       layer["attn"]["wk"].astype(dt))
+        v = jnp.einsum("cd,dhk->chk", h,
+                       layer["attn"]["wv"].astype(dt))
+        if cfg.pos_encoding == "rope":
+            q = _rope_bhd(q, abs_pos)
+            k = _rope_bhd(k, abs_pos)
+        kp, vp = _write_kv(kp, vp, k.astype(kp.dtype),
+                           v.astype(vp.dtype), page_ids, offsets)
+        if first:
+            attn = dot_product_attention(
+                q[None], k[None], v[None], causal=True,
+                impl=cfg.attention_impl
+                if cfg.attention_impl in ("auto", "flash", "naive")
+                else "auto",
+                window=0)[0]
+        else:
+            attn = paged_attention_chunk(
+                q[None], kp, vp, page_row[None], q_pos)[0]
+        x = x + jnp.einsum("chk,hkd->cd", attn,
+                           layer["attn"]["wo"].astype(dt))
+        h = _layer_norm(x, layer["ln2"]["scale"],
+                        layer["ln2"]["bias"])
+        m = layer["mlp"]
+        u = jax.nn.gelu(jnp.einsum("cd,df->cf", h,
+                                   m["wi"].astype(dt))
+                        + m["bi"].astype(dt))
+        x = x + (jnp.einsum("cf,fd->cd", u, m["wo"].astype(dt))
+                 + m["bo"].astype(dt))
+        return x, (kp, vp)
+
+    x, (k_pages, v_pages) = jax.lax.scan(
+        layer_body, x, (stacked, k_pages, v_pages))
+    x_last = jax.lax.dynamic_index_in_dim(
+        x, jnp.maximum(n_valid - 1, 0), axis=0, keepdims=False)
+    x_last = _layer_norm(x_last, params["final_norm"]["scale"],
+                         params["final_norm"]["bias"])
+    head = (params["tok_embed"].T if cfg.tie_embeddings
+            else params["lm_head"])
+    logits = jnp.einsum("d,dv->v", x_last,
+                        head.astype(dt)).astype(jnp.float32)
+    return logits, k_pages, v_pages

@@ -1,0 +1,235 @@
+"""Paged KV cache: fixed-size pages in one preallocated, sharded pool.
+
+Per-request max_len buffers waste HBM quadratically under continuous
+batching (every slot reserves the worst case); the paged layout is
+virtual memory for KV instead. One reservation of ``num_pages`` pages
+of ``page_size`` tokens each, per layer, kv-head-major:
+
+    k_pages, v_pages: (n_layers, n_kv_heads, num_pages, page_size,
+                       head_dim)
+
+A sequence owns an ordered list of physical page ids (its PAGE TABLE);
+logical position ``p`` lives in slot ``p % page_size`` of its
+``p // page_size``-th page. Join = allocate pages from the free list,
+evict = return them — no copying, no compaction, and the device
+arrays never change shape, so the decode program never recompiles.
+
+**Page 0 is the scratch page**: never allocated, the write target for
+inactive batch slots and padding positions (the jitted decode/prefill
+programs write unconditionally; pointing dead writes at scratch keeps
+them out of live pages without dynamic shapes). Unused page-table
+entries also point at it — their slots are masked out of attention by
+position, so the garbage is never read into a softmax.
+
+**Sharding**: on a multi-device mesh the pool is sharded along the
+kv-head axis over the plan's ``tp`` mesh axis (the decode plan's head
+currency — serving's analogue of the training tp head shard), and
+replicated elsewhere. Page tables/lengths are tiny int32 rows and stay
+replicated.
+
+**Accounting**: the allocator is host-side (plain Python — allocation
+decisions are control flow, not math) and every alloc/free emits a
+``serving_kv`` telemetry record with the pool occupancy, which the
+metrics endpoint folds into ``dtt_serving_kv_pages_{used,total}``.
+Invariant (pinned by test): ``pages_used + free == num_pages - 1``
+always, and freeing every sequence returns occupancy to zero — the
+pool cannot leak under any join/evict order.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from distributed_training_tpu.telemetry import event
+
+
+@dataclass(frozen=True)
+class PagedCacheConfig:
+    """Pool geometry. ``max_seq_len`` bounds pages per sequence."""
+
+    n_layers: int
+    n_kv_heads: int
+    head_dim: int
+    page_size: int = 16
+    num_pages: int = 128          # scratch page 0 included
+    max_seq_len: int = 256
+    dtype: str = "float32"
+
+    def __post_init__(self):
+        if self.page_size < 1:
+            raise ValueError(f"page_size must be >= 1, got "
+                             f"{self.page_size}")
+        if self.num_pages < 2:
+            raise ValueError(
+                f"num_pages must be >= 2 (page 0 is scratch), got "
+                f"{self.num_pages}")
+        if self.max_seq_len % self.page_size:
+            raise ValueError(
+                f"max_seq_len ({self.max_seq_len}) must be a multiple "
+                f"of page_size ({self.page_size})")
+
+    @property
+    def pages_per_seq(self) -> int:
+        return self.max_seq_len // self.page_size
+
+    @property
+    def usable_pages(self) -> int:
+        return self.num_pages - 1  # minus scratch
+
+    def kv_bytes_per_token(self) -> int:
+        """HBM cost of one cached token across all layers (k + v)."""
+        itemsize = np.dtype(self.dtype).itemsize
+        return (2 * self.n_layers * self.n_kv_heads * self.head_dim
+                * itemsize)
+
+
+class PagedKVCache:
+    """The pool + its host-side allocator and per-sequence tables.
+
+    ``mesh``/``kv_axis``: shard the pools' kv-head dim over that mesh
+    axis (skipped when the axis has extent 1 or no mesh is given).
+    The device pools are handed to the engine's jitted programs as
+    donated inputs; the engine writes the updated arrays back via
+    ``update_pools`` each step.
+    """
+
+    def __init__(self, cfg: PagedCacheConfig, mesh=None,
+                 kv_axis: str | None = None):
+        import jax
+        import jax.numpy as jnp
+
+        self.cfg = cfg
+        shape = (cfg.n_layers, cfg.n_kv_heads, cfg.num_pages,
+                 cfg.page_size, cfg.head_dim)
+        dt = jnp.dtype(cfg.dtype)
+        sharding = None
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+            ax = kv_axis if kv_axis and sizes.get(kv_axis, 1) > 1 \
+                else None
+            if ax is not None and cfg.n_kv_heads % sizes[ax]:
+                raise ValueError(
+                    f"kv pool cannot shard {cfg.n_kv_heads} kv heads "
+                    f"over {kv_axis}={sizes[ax]}")
+            sharding = NamedSharding(mesh, P(None, ax))
+        self.sharding = sharding
+
+        def pool():
+            # Two DISTINCT buffers: k and v are donated separately to
+            # the jitted programs, and donating one aliased array
+            # twice is an XLA error.
+            z = jnp.zeros(shape, dt)
+            return jax.device_put(z, sharding) \
+                if sharding is not None else z
+
+        self.k_pages = pool()
+        self.v_pages = pool()
+        # Host allocator state. Free list is LIFO: recently-freed
+        # pages are re-handed first (warm in cache, and deterministic
+        # for the tests' join/evict permutations).
+        self._free: list[int] = list(range(cfg.num_pages - 1, 0, -1))
+        self._tables: dict[object, list[int]] = {}
+        self._lengths: dict[object, int] = {}
+
+    # -- allocator ---------------------------------------------------------
+
+    @property
+    def pages_used(self) -> int:
+        return self.cfg.usable_pages - len(self._free)
+
+    @property
+    def seqs(self) -> int:
+        return len(self._tables)
+
+    def _emit(self, op: str, seq_id) -> None:
+        event("serving_kv", op=op, seq=str(seq_id),
+              pages_used=self.pages_used,
+              pages_total=self.cfg.usable_pages, seqs=self.seqs)
+
+    def can_admit(self, n_tokens: int) -> bool:
+        """Would ``ensure`` succeed for a NEW sequence of n_tokens?"""
+        need = -(-max(1, n_tokens) // self.cfg.page_size)
+        return need <= len(self._free)
+
+    def join(self, seq_id) -> None:
+        if seq_id in self._tables:
+            raise KeyError(f"sequence {seq_id!r} already joined")
+        self._tables[seq_id] = []
+        self._lengths[seq_id] = 0
+        self._emit("join", seq_id)
+
+    def ensure(self, seq_id, n_tokens: int) -> bool:
+        """Grow seq_id's table to cover ``n_tokens`` total positions.
+        Returns False (allocating NOTHING — admission is atomic per
+        call) when the free list cannot cover the growth; the engine
+        treats that as backpressure and defers the work."""
+        if n_tokens > self.cfg.max_seq_len:
+            raise ValueError(
+                f"sequence {seq_id!r} needs {n_tokens} positions, "
+                f"pool max_seq_len is {self.cfg.max_seq_len}")
+        table = self._tables[seq_id]
+        need = -(-n_tokens // self.cfg.page_size) - len(table)
+        if need <= 0:
+            return True
+        if need > len(self._free):
+            return False
+        for _ in range(need):
+            table.append(self._free.pop())
+        self._emit("grow", seq_id)
+        return True
+
+    def advance(self, seq_id, n_tokens: int) -> None:
+        """Record ``n_tokens`` more positions as written (pages must
+        already be ensured)."""
+        new_len = self._lengths[seq_id] + n_tokens
+        table = self._tables[seq_id]
+        if new_len > len(table) * self.cfg.page_size:
+            raise RuntimeError(
+                f"sequence {seq_id!r}: advancing to {new_len} "
+                f"positions but only {len(table)} page(s) allocated "
+                "— ensure() first")
+        self._lengths[seq_id] = new_len
+
+    def free(self, seq_id) -> int:
+        """Evict: return the sequence's pages to the pool. Returns the
+        page count released."""
+        table = self._tables.pop(seq_id)
+        del self._lengths[seq_id]
+        self._free.extend(reversed(table))
+        self._emit("free", seq_id)
+        return len(table)
+
+    def length(self, seq_id) -> int:
+        return self._lengths[seq_id]
+
+    def occupancy(self) -> dict:
+        return {"pages_used": self.pages_used,
+                "pages_total": self.cfg.usable_pages,
+                "seqs": self.seqs}
+
+    # -- device-side views -------------------------------------------------
+
+    def page_row(self, seq_id) -> np.ndarray:
+        """(pages_per_seq,) int32 page-table row, scratch-padded."""
+        row = np.zeros((self.cfg.pages_per_seq,), np.int32)
+        table = self._tables[seq_id]
+        row[:len(table)] = table
+        return row
+
+    def page_rows(self, seq_ids: list) -> np.ndarray:
+        """(len(seq_ids), pages_per_seq) int32 table; ``None`` entries
+        (empty batch slots) become all-scratch rows."""
+        rows = np.zeros((len(seq_ids), self.cfg.pages_per_seq),
+                        np.int32)
+        for i, sid in enumerate(seq_ids):
+            if sid is not None:
+                rows[i] = self.page_row(sid)
+        return rows
+
+    def update_pools(self, k_pages, v_pages) -> None:
+        """Adopt the jitted program's updated (donated-in) pools."""
+        self.k_pages = k_pages
+        self.v_pages = v_pages

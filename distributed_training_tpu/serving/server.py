@@ -1,0 +1,361 @@
+"""Serving HTTP server: a stdlib generate endpoint over the engine.
+
+The thin network front of the serving subsystem — deliberately the
+same stdlib-only discipline as ``telemetry/metrics_server.py`` (no
+framework dependency for a repo whose serving claims must run in the
+CI container):
+
+- ``POST /generate`` — JSON ``{"prompt_ids": [...]}`` or (byte-vocab
+  models) ``{"text": "..."}``, plus ``max_new_tokens``; blocks until
+  the request drains through the continuous-batching engine and
+  returns ``{"tokens", "text"?, "ttft_s", "latency_s"}``. Requests
+  from many connections interleave in the engine's running batch —
+  the HTTP handler threads only enqueue and wait.
+- ``GET /healthz`` — 200 with queue/slot stats while the engine
+  thread is alive.
+- live gauges — the engine's telemetry records flow through the
+  ambient sink to a ``MetricsServer`` (``metrics_port``), which
+  exports the ``dtt_serving_*`` gauges next to the training set: one
+  observer pattern, one ``/metrics`` schema, two workloads.
+
+Threading model: HTTP handlers never touch the engine. They append
+to a mailbox; the single engine thread admits mailbox requests,
+steps the engine, and signals completion events. The engine stays
+single-threaded (its allocator and jit carry no locks), and a
+slow/disconnected client cannot stall decode.
+
+CLI::
+
+    python -m distributed_training_tpu.serving.server \
+        --artifact model.msgpack --plan serving_8dev_cpu_decode \
+        --port 8100 --metrics-port 8101
+"""
+
+from __future__ import annotations
+
+import argparse
+import http.server
+import json
+import logging
+import threading
+import time
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+
+class ServingServer:
+    """HTTP front + engine thread over a built Engine."""
+
+    def __init__(self, engine, port: int = 0,
+                 metrics_port: int | None = None, telemetry=None):
+        self.engine = engine
+        self._requested_port = port
+        self.port: int | None = None
+        self._mailbox: list = []
+        self._done: dict[str, dict] = {}
+        self._events: dict[str, threading.Event] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._httpd = None
+        self._engine_thread = None
+        self._http_thread = None
+        self._next_id = 0
+        self.metrics = None
+        if metrics_port is not None:
+            from distributed_training_tpu.telemetry import (
+                MetricsServer)
+            self.metrics = MetricsServer(metrics_port,
+                                         telemetry=telemetry)
+
+    # -- engine thread -----------------------------------------------------
+
+    def _engine_loop(self) -> None:
+        from distributed_training_tpu.serving.engine import Request
+
+        eng = self.engine
+        while not self._stop.is_set():
+            with self._lock:
+                incoming, self._mailbox = self._mailbox, []
+            for rid, prompt, n, arrival in incoming:
+                try:
+                    eng.submit(Request(id=rid, prompt=prompt,
+                                       max_new_tokens=n,
+                                       arrival=arrival))
+                except ValueError as e:
+                    # An invalid request answers ITS caller; it must
+                    # never take down the engine thread (and with it
+                    # every other in-flight request).
+                    with self._lock:
+                        ev = self._events.pop(rid, None)
+                        if ev is not None:
+                            self._done[rid] = {"id": rid,
+                                               "error": str(e)}
+                            ev.set()
+            if eng.idle:
+                time.sleep(0.002)
+                continue
+            eng.step()
+            if eng.completed:
+                with self._lock:
+                    for rec in eng.completed:
+                        ev = self._events.pop(rec["id"], None)
+                        if ev is not None:
+                            self._done[rec["id"]] = rec
+                            ev.set()
+                eng.completed.clear()
+
+    def generate(self, prompt: np.ndarray, max_new_tokens: int,
+                 timeout: float = 120.0) -> dict:
+        """Enqueue + wait (the HTTP handler path; also the in-process
+        API tests use)."""
+        arrival = time.monotonic()
+        ev = threading.Event()
+        with self._lock:
+            rid = f"http-{self._next_id}"
+            self._next_id += 1
+            self._events[rid] = ev
+            self._mailbox.append((rid, np.asarray(prompt, np.int32),
+                                  int(max_new_tokens), arrival))
+        if not ev.wait(timeout):
+            with self._lock:
+                # Deregister so a late completion is dropped instead
+                # of accumulating forever in _done.
+                self._events.pop(rid, None)
+                self._done.pop(rid, None)
+            raise TimeoutError(f"request {rid} timed out")
+        with self._lock:
+            return self._done.pop(rid)
+
+    # -- HTTP --------------------------------------------------------------
+
+    def _handle_generate(self, body: dict) -> dict:
+        vocab = self.engine.model.cfg.vocab_size
+        if "prompt_ids" in body:
+            ids = np.asarray([int(t) for t in body["prompt_ids"]],
+                             np.int32)
+        elif "text" in body:
+            if vocab != 256:
+                raise ValueError(
+                    "'text' prompts need a byte-vocab (256) model; "
+                    "pass 'prompt_ids'")
+            ids = np.frombuffer(
+                body["text"].encode("utf-8"),
+                dtype=np.uint8).astype(np.int32)
+        else:
+            raise ValueError("body needs 'prompt_ids' or 'text'")
+        if ids.size == 0:
+            raise ValueError("empty prompt")
+        if ids.size and (ids.min() < 0 or ids.max() >= vocab):
+            raise ValueError(f"prompt ids must be in [0, {vocab})")
+        n = int(body.get("max_new_tokens", 16))
+        limit = self.engine.cfg.max_seq_len
+        if n < 1 or ids.size + n > limit:
+            raise ValueError(
+                f"prompt ({ids.size}) + max_new_tokens ({n}) must "
+                f"fit max_seq_len ({limit})")
+        rec = self.generate(ids, n)
+        if "error" in rec:
+            raise ValueError(rec["error"])
+        out = {"tokens": rec["tokens"], "ttft_s": rec["ttft_s"],
+               "latency_s": rec["latency_s"]}
+        if vocab == 256:
+            out["text"] = bytes(
+                np.asarray(rec["tokens"], np.uint8)).decode(
+                    "utf-8", errors="replace")
+        return out
+
+    def start(self) -> "ServingServer | None":
+        server = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def _reply(self, code: int, payload: dict) -> None:
+                body = (json.dumps(payload) + "\n").encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_POST(self):  # noqa: N802 — http.server API
+                if self.path.split("?")[0] != "/generate":
+                    self._reply(404, {"error": "try POST /generate"})
+                    return
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    body = json.loads(self.rfile.read(n) or b"{}")
+                    self._reply(200, server._handle_generate(body))
+                except (ValueError, KeyError) as e:
+                    self._reply(400, {"error": str(e)})
+                except TimeoutError as e:
+                    self._reply(504, {"error": str(e)})
+
+            def do_GET(self):  # noqa: N802 — http.server API
+                if self.path.split("?")[0] != "/healthz":
+                    self._reply(404, {"error": "try /healthz or the "
+                                               "metrics port"})
+                    return
+                eng = server.engine
+                self._reply(200, {
+                    "status": "ok",
+                    "in_flight": eng.in_flight,
+                    "queue_depth": len(eng.queue),
+                    **eng.cache.occupancy()})
+
+            def log_message(self, fmt, *args):
+                logger.debug("serving http: " + fmt, *args)
+
+        try:
+            self._httpd = http.server.ThreadingHTTPServer(
+                ("0.0.0.0", self._requested_port), Handler)
+        except OSError as e:
+            logger.warning("serving endpoint NOT started (port %s): "
+                           "%s", self._requested_port, e)
+            return None
+        self.port = self._httpd.server_address[1]
+        if self.metrics is not None:
+            self.metrics.start()
+        self._engine_thread = threading.Thread(
+            target=self._engine_loop, name="serving-engine",
+            daemon=True)
+        self._engine_thread.start()
+        self._http_thread = threading.Thread(
+            target=self._httpd.serve_forever, name="serving-http",
+            daemon=True)
+        self._http_thread.start()
+        logger.info("serving endpoint on :%d (POST /generate)",
+                    self.port)
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        if self.metrics is not None:
+            self.metrics.stop()
+        for t in (self._engine_thread, self._http_thread):
+            if t is not None:
+                t.join(timeout=5)
+        self._engine_thread = self._http_thread = None
+
+
+def engine_config_from_yaml(plan, engine_block: dict):
+    """conf/serving/*.yaml ``engine:`` block → EngineConfig, with 0
+    meaning "take the plan's value" (engine_config_for_plan)."""
+    import dataclasses
+
+    from distributed_training_tpu.serving.disagg import (
+        engine_config_for_plan)
+
+    base = engine_config_for_plan(
+        plan,
+        page_size=int(engine_block.get("page_size", 16)),
+        prefill_chunk=int(engine_block.get("prefill_chunk", 16)))
+    # 0 / empty = "keep the plan-derived value" for every knob
+    # (temperature 0 IS the plan-derived greedy default).
+    over = {k: v for k, v in engine_block.items()
+            if k in ("max_batch", "num_pages", "max_seq_len",
+                     "policy", "temperature", "top_k")
+            and v not in (0, 0.0, None, "")}
+    return dataclasses.replace(base, **over)
+
+
+def build_server(artifact: str, plan_name: str, port: int = 0,
+                 metrics_port: int | None = None,
+                 telemetry=None,
+                 engine_block: dict | None = None) -> ServingServer:
+    """Artifact + committed plan → laid-out engine → server.
+
+    The provenance gate lives in WeightStore: an artifact whose
+    recorded source plan no longer matches its committed fingerprint
+    refuses to serve (serving/disagg.py)."""
+    import jax
+
+    from distributed_training_tpu.parallel.planner import (
+        load_plan, model_for_plan)
+    from distributed_training_tpu.runtime import build_mesh, MeshSpec
+    from distributed_training_tpu.serving.disagg import WeightStore
+    from distributed_training_tpu.serving.engine import Engine
+
+    plan = load_plan(plan_name)
+    store = WeightStore(artifact)
+    model = model_for_plan(plan)
+    spec = MeshSpec(**{a: plan.mesh.get(a, 1)
+                       for a in ("pp", "dp", "fsdp", "sp", "tp")})
+    mesh = build_mesh(spec, jax.devices()[:spec.total])
+    ecfg = engine_config_from_yaml(plan, engine_block or {})
+    engine = Engine(model, store.params_for(mesh, plan), ecfg,
+                    mesh=mesh)
+    engine.warmup()
+    return ServingServer(engine, port=port,
+                         metrics_port=metrics_port,
+                         telemetry=telemetry)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m distributed_training_tpu.serving.server",
+        description="Continuous-batching inference server.")
+    ap.add_argument("--artifact", required=True,
+                    help="consolidated export (checkpoint/export.py)")
+    ap.add_argument("--plan", default=None,
+                    help="committed decode plan name (conf/plans/); "
+                         "default: the --config file's plan")
+    ap.add_argument("--config", default=None,
+                    help="serving YAML (conf/serving/default.yaml): "
+                         "engine geometry, scheduling policy, ports; "
+                         "explicit flags win per key")
+    ap.add_argument("--port", type=int, default=None)
+    ap.add_argument("--metrics-port", type=int, default=None)
+    args = ap.parse_args(argv)
+
+    conf: dict = {}
+    if args.config:
+        import yaml
+        with open(args.config) as f:
+            conf = yaml.safe_load(f) or {}
+    plan_name = args.plan or conf.get("plan")
+    if not plan_name:
+        ap.error("no plan: pass --plan or a --config with one")
+    srv_conf = conf.get("server") or {}
+    port = args.port if args.port is not None \
+        else int(srv_conf.get("port", 8100))
+    metrics_port = args.metrics_port if args.metrics_port is not None \
+        else int(srv_conf.get("metrics_port", 8101))
+
+    import os
+
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms",
+                          os.environ["JAX_PLATFORMS"])
+    from distributed_training_tpu.telemetry import (Telemetry,
+                                                    install)
+    # The sink must be ENABLED (jsonl-backed) for the observer chain
+    # to fire — a disabled Telemetry emits nothing and the gauges
+    # would stay empty (telemetry/events.py::_emit's fast path).
+    tel = install(Telemetry(events_jsonl=os.path.join(
+        "outputs", "serving", "events.jsonl")))
+    srv = build_server(args.artifact, plan_name, port=port,
+                       metrics_port=metrics_port, telemetry=tel,
+                       engine_block=conf.get("engine") or {})
+    if srv.start() is None:
+        return 1
+    print(f"serving on :{srv.port} (metrics :{metrics_port}); "
+          "Ctrl-C to stop")
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        srv.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -164,6 +164,38 @@ class MetricsServer:
                 if isinstance(rec.get("bytes_per_step"), (int, float)):
                     self._gauges["collective_bytes_per_step"] = \
                         rec["bytes_per_step"]
+            elif kind == "serving":
+                # Engine step records (serving/engine.py) — additive
+                # serving gauges next to the training ones; schema
+                # pinned by tests/test_serving.py.
+                for src, dst in (
+                        ("in_flight", "serving_requests_in_flight"),
+                        ("queue_depth", "serving_queue_depth"),
+                        ("pages_used", "serving_kv_pages_used"),
+                        ("pages_total", "serving_kv_pages_total")):
+                    if isinstance(rec.get(src), (int, float)):
+                        self._gauges[dst] = float(rec[src])
+                dur = rec.get("dur_s")
+                toks = rec.get("tokens")
+                if isinstance(dur, (int, float)) and dur > 0 \
+                        and isinstance(toks, (int, float)) and toks:
+                    self._gauges["serving_tokens_per_s"] = toks / dur
+            elif kind == "serving_kv":
+                # Allocator records: keep occupancy live even between
+                # engine steps (join/evict happen inside steps, but
+                # warmup/adopt/preempt touch the pool outside them).
+                for src, dst in (
+                        ("pages_used", "serving_kv_pages_used"),
+                        ("pages_total", "serving_kv_pages_total")):
+                    if isinstance(rec.get(src), (int, float)):
+                        self._gauges[dst] = float(rec[src])
+            elif kind == "serving_request":
+                if isinstance(rec.get("ttft_s"), (int, float)):
+                    self._gauges["serving_ttft_seconds"] = \
+                        rec["ttft_s"]
+                self._counters["serving_requests_total"] = \
+                    self._counters.get("serving_requests_total",
+                                       0.0) + 1
 
     # -- health --------------------------------------------------------
 
@@ -225,6 +257,17 @@ class MetricsServer:
         "straggler_verdicts_total": "Cumulative persistent straggler "
                                     "verdicts observed",
         "up": "1 while the run is serving metrics",
+        "serving_requests_in_flight": "Sequences in the engine's "
+                                      "slot table (serving/)",
+        "serving_queue_depth": "Requests waiting for admission",
+        "serving_kv_pages_used": "KV-cache pages allocated",
+        "serving_kv_pages_total": "KV-cache pages in the pool "
+                                  "(scratch excluded)",
+        "serving_ttft_seconds": "Time-to-first-token of the last "
+                                "completed request",
+        "serving_tokens_per_s": "Decode throughput of the last "
+                                "engine step",
+        "serving_requests_total": "Requests completed by the engine",
     }
 
     def render(self) -> str:

@@ -134,6 +134,47 @@ def test_generate_moved_run_dir_falls_back_to_local(byte_run, capsys,
     assert "sampled=4" in capsys.readouterr().err
 
 
+def test_generate_paged_decode_matches_full_context(byte_run,
+                                                    capsys):
+    """The serving-KV-cache decode path the CLI now defaults to for
+    greedy generation is pinned token-for-token against the ORIGINAL
+    full-context discipline: re-run the whole context through
+    model.apply for every new token and argmax."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    # CLI, default (paged) greedy path.
+    rc = gen_cli.main(["--run-dir", byte_run, "--prompt", "hello",
+                       "-n", "8"])
+    assert rc == 0
+    out_paged = capsys.readouterr().out.rstrip("\n")
+
+    # Full-context greedy reference on the same restored weights.
+    cfg = gen_cli._load_run_config(byte_run)
+    model = gen_cli._build_model_from_cfg(cfg)
+    params, _step = gen_cli._restore_params(
+        byte_run, cfg.train.snapshot_path, None)
+    ids = list(np.frombuffer(b"hello", dtype=np.uint8)
+               .astype(np.int32))
+    ref = []
+    for _ in range(8):
+        logits, _aux = model.apply(params,
+                                   jnp.asarray([ids], jnp.int32))
+        t = int(jnp.argmax(logits[0, -1]))
+        ref.append(t)
+        ids.append(t)
+    ref_text = bytes(np.asarray(ref, np.uint8)).decode(
+        "utf-8", errors="replace")
+    assert out_paged == ref_text
+
+    # The legacy fused dense-cache path agrees too (three decode
+    # disciplines, one token stream).
+    rc = gen_cli.main(["--run-dir", byte_run, "--decode", "fused",
+                       "--prompt", "hello", "-n", "8"])
+    assert rc == 0
+    assert capsys.readouterr().out.rstrip("\n") == ref_text
+
+
 def test_eval_cli_scores_checkpoint(byte_run, capsys):
     """Offline eval: the run's own dataset scores to a finite loss,
     and the loss ties back to training (an untrained-vocab-256 model
