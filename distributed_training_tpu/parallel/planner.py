@@ -163,16 +163,17 @@ class PlanTarget:
     max_compiles: int = 4
     # What the plan optimizes. "train": step throughput under the
     # training memory model (params+grads+optimizer+activations) —
-    # the historical objective. "decode": serving decode LATENCY with
-    # HBM-FOR-KV feasibility (params + the paged KV pool must fit;
-    # score = decode steps/second, so a layout that all-gathers
-    # weights per token prices itself out) — serving/engine.py's
-    # whole-batch one-token program. "prefill": forward-only chunk
-    # THROUGHPUT (no grad/optimizer state, no backward collectives)
-    # — the engine's prompt side. The serving objectives fix remat to
-    # "none" (no backward to trade memory against) and exclude sp/pp
-    # (the decode/prefill programs have no sequence-parallel or
-    # pipelined form).
+    # the historical objective. "decode": AGGREGATE serving decode
+    # tokens/second with HBM-FOR-KV feasibility (params + this
+    # device's pool shard must fit; the slot table batch-shards over
+    # dp — serving/engine.py's shard_map — so dp divides pool bytes
+    # and step latency for free while a layout that all-gathers
+    # weights per token prices itself out). "prefill": forward-only
+    # chunk THROUGHPUT (no grad/optimizer state, no backward
+    # collectives) — the engine's prompt side. The serving
+    # objectives fix remat to "none" (no backward to trade memory
+    # against) and exclude sp/pp (the decode/prefill programs have
+    # no sequence-parallel or pipelined form).
     objective: str = "train"
     note: str = ""
 
@@ -255,18 +256,22 @@ _register(PlanTarget(
     seq_len=64,
     optimizer="none",
     chip="cpu",
-    # HBM-for-KV budget sized so a REPLICATED pool (tp=1) for 32
-    # slots x 64 positions does not fit but the kv-head-sharded
-    # (tp=2) pool does — the decode objective's whole point: the
-    # latency-optimal layout is forced by KV residency, exactly the
-    # 7B-scale story in miniature (docs/serving.md works the math).
-    hbm_gib=0.00095,
+    # HBM budget sized so the all-dp layout (dp8·tp1 — pool fully
+    # batch-sharded but params REPLICATED on every device) does not
+    # fit, while dp4·tp2 (params + kv heads sharded over tp, slots
+    # dealt over dp) does — the decode objective's forced choice
+    # since the slot table batch-shards over dp: dp is free
+    # throughput, tp costs all-reduces but is the only thing that
+    # shrinks resident params; the budget makes tp mandatory and dp
+    # soaks up the rest (docs/serving.md works the math).
+    hbm_gib=0.0005,
     batch_candidates=(32,),
     objective="decode",
     note="The serving decode plan benchmarks/bench_serving.py lays "
-         "the engine out with (SERVING_r01): 32 decode slots, paged "
-         "KV pool head-sharded over tp. Audited reshard-clean by the "
-         "serving_decode_planned analysis target.",
+         "the engine out with (SERVING_r02): 32 decode slots dealt "
+         "over dp4 groups of 8, paged KV pool sharded dp×tp. "
+         "Audited reshard-clean by the serving_decode_planned "
+         "analysis target.",
 ))
 
 _register(PlanTarget(
@@ -293,9 +298,10 @@ _register(PlanTarget(
     seq_len=64,
     optimizer="none",
     chip="cpu",
-    # Same HBM-for-KV squeeze as the 8-device decode target, at the
-    # 4-device slice's 16 slots: replicated pool out, tp=2 in.
-    hbm_gib=0.00065,
+    # Same params-force-tp squeeze as the 8-device decode target, at
+    # the 4-device slice's 16 slots: dp4·tp1 (replicated params) out,
+    # dp2·tp2 in.
+    hbm_gib=0.0005,
     batch_candidates=(16,),
     objective="decode",
     note="Decode-slice layout for the disaggregated pipeline: the KV "
@@ -735,16 +741,21 @@ def _score_serving(target: PlanTarget, cand: Candidate,
     The training objective maximizes step THROUGHPUT under the
     training memory model; serving wants something else entirely:
 
-    - **decode**: score = decode steps/second (LATENCY — one token
-      for the whole active batch per step), and feasibility is
-      HBM-FOR-KV: per-device params + the paged KV pool for
-      ``global_batch`` sequences of ``seq_len`` tokens must fit the
-      budget. The pool shards only over ``tp`` (kv heads —
-      serving/kv_cache.py's axis); ``fsdp`` shrinks resident params
-      but pays a FULL weight all-gather every decode step, which the
-      comms term prices — exactly the trade that makes tp the
-      latency-optimal decode layout once the replicated pool stops
-      fitting.
+    - **decode**: score = AGGREGATE decode tokens/second (one token
+      per sequence per step across the dealt slot table; step
+      latency is the denominator, so dp's batch-parallel groups are
+      credited without any new collective — decode rows are
+      independent), and feasibility is HBM-FOR-KV: per-device params
+      + this device's shard of the paged KV pool for
+      ``batch_per_shard`` sequences of ``seq_len`` tokens must fit
+      the budget. The pool shards over ``dp`` (the batch-sharded
+      slot groups, serving/engine.py's shard_map) × ``tp`` (kv heads
+      — serving/kv_cache.py's head axis); params shard only over
+      ``tp``/``fsdp``, and ``fsdp`` pays a FULL weight all-gather
+      every decode step, which the comms term prices — exactly the
+      trade that forces tp in once per-device params + pool stop
+      fitting replicated, while dp soaks up the remaining devices
+      for free throughput.
     - **prefill**: forward-only chunk throughput — the train roofline
       minus backward (no grad reduce-scatter, no optimizer state,
       half the tp crossings), score = prompt tokens/second.
@@ -776,36 +787,45 @@ def _score_serving(target: PlanTarget, cand: Candidate,
         "hbm_budget_gib": round(hbm_budget_gib(target), 6),
     }
     if target.objective == "decode":
-        # Decode semantics (engine.py): the SLOT TABLE is replicated
-        # — batch_per_shard IS the concurrent-sequence count, on
-        # every device; only tp shards the per-token compute and the
-        # KV pool (kv heads). dp/fsdp neither shard slots nor speed a
-        # decode step up; fsdp shrinks RESIDENT params but pays a
-        # full weight all-gather per token, priced below.
+        # Decode semantics (engine.py): the SLOT TABLE is BATCH-
+        # SHARDED over dp — batch_per_shard is the AGGREGATE
+        # concurrent-sequence count, dealt into dp groups of
+        # slots/dp, each group decoding only its own slots against
+        # its own KV pool shard (the shard_map program). dp therefore
+        # divides the pool's HBM, the per-device compute, AND the
+        # per-group tp activation traffic, and adds ZERO collectives
+        # of its own (decode rows are independent); tp still shards
+        # per-token compute + the pool's kv heads but pays the
+        # activation all-reduces. fsdp shrinks RESIDENT params but
+        # pays a full weight all-gather per token, priced below.
         slots = B_shard
-        kv_dev = slots * S * kv_tok / cand.tp
-        act_dev = slots * (4 * D + 2 * cfg.d_ff) * ab
+        if slots % cand.dp:
+            rec.update(feasible=False, reason="slots%dp", score=0.0)
+            return rec
+        slots_local = slots // cand.dp
+        kv_dev = slots * S * kv_tok / (cand.dp * cand.tp)
+        act_dev = slots_local * (4 * D + 2 * cfg.d_ff) * ab
         total = params_dev + kv_dev + act_dev
         rec["hbm_gib"] = round(total / 2**30, 6)
         rec["kv_pool_gib"] = round(kv_dev / 2**30, 6)
         rec["kv_capacity_tokens"] = int(
             max(0.0, budget - params_dev - act_dev)
-            * cand.tp / kv_tok)
+            * cand.dp * cand.tp / kv_tok)
         if total > budget:
             rec.update(feasible=False, reason="hbm", score=0.0)
             return rec
-        # Forward FLOPs for one token across the active batch
-        # (fwd ≈ 1/3 of the fwd+bwd accounting); tp is the only axis
-        # that divides them.
+        # Forward FLOPs for one token across the aggregate batch
+        # (fwd ≈ 1/3 of the fwd+bwd accounting); dp shards the rows,
+        # tp the per-row math.
         model = Transformer(cfg)
         flops_step = (model.flops_per_token(S) / 3.0) * slots
-        flops_per_dev = flops_step / cand.tp
+        flops_per_dev = flops_step / (cand.dp * cand.tp)
         by_kind = {}
         if cand.fsdp > 1:
             by_kind["all-gather"] = n_params * ab
         if cand.tp > 1:
             by_kind["all-reduce"] = 2.0 * 2.0 * cfg.n_layers \
-                * slots * D * ab
+                * slots_local * D * ab
         tokens = slots  # one token per sequence per step
     else:  # prefill
         act_dev = B_shard * S * (4 * D + 2 * cfg.d_ff) * ab
@@ -849,10 +869,12 @@ def _score_serving(target: PlanTarget, cand: Candidate,
                              if b > 0},
         calibrated=calib is not None,
         tokens_per_step=tokens,
-        # decode: steps/second (latency objective — batch size does
-        # not inflate it); prefill: tokens/second (throughput).
-        score=(1.0 / step_s if target.objective == "decode"
-               else tokens / step_s) if step_s > 0 else 0.0,
+        # decode: AGGREGATE tokens/second — one token per sequence
+        # per step across the whole dealt slot table, so dp's
+        # batch-parallel groups are credited while per-token latency
+        # (step_s) stays the denominator; prefill: prompt
+        # tokens/second (throughput).
+        score=tokens / step_s if step_s > 0 else 0.0,
     )
     return rec
 

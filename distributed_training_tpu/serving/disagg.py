@@ -40,8 +40,8 @@ import numpy as np
 from distributed_training_tpu.serving.engine import (
     Engine,
     EngineConfig,
-    _decode_program,
-    _prefill_program,
+    build_decode_fn,
+    build_prefill_fn,
 )
 
 logger = logging.getLogger(__name__)
@@ -171,48 +171,112 @@ def export_kv(cache, seq_id):
     """A sequence's KV as dense host arrays (L, Hkv, len, hd) —
     page-table indirection resolved, ready to cross a mesh boundary
     (the handoff wire format; at pod scale this is the DCN payload)."""
-    table = cache.page_row(seq_id)
-    n = cache.length(seq_id)
-    n_pages = -(-n // cache.cfg.page_size) if n else 0
-    pages = table[:n_pages]
-    # Slice ON DEVICE before pulling to host: np.asarray(pool) would
-    # materialize the ENTIRE pool per handoff; this transfers only
-    # the sequence's own pages.
-    k = np.asarray(cache.k_pages[:, :, pages])   # (L,Hkv,p,ps,hd)
-    v = np.asarray(cache.v_pages[:, :, pages])
-    L, Hkv, p, ps, hd = k.shape
-    k = k.reshape(L, Hkv, p * ps, hd)[:, :, :n]
-    v = v.reshape(L, Hkv, p * ps, hd)[:, :, :n]
-    return k, v
+    k, v = export_kv_batch(cache, [seq_id])
+    return k[0], v[0]
+
+
+def export_kv_batch(cache, seq_ids):
+    """Dense KV for MANY in-flight sequences in ONE device→host
+    transfer — the continuous-handoff rate path: the page gather for
+    every sequence in the batch is a single device slice instead of
+    one transfer per request (per-request ``export_kv`` is this with
+    a batch of one, so the two can never produce different bytes).
+    Returns ``(ks, vs)`` — parallel lists of (L, Hkv, len_i, hd)
+    arrays."""
+    pages_of, lens = [], []
+    for sid in seq_ids:
+        n = cache.length(sid)
+        n_pages = -(-n // cache.cfg.page_size) if n else 0
+        pages_of.append((cache.group_of(sid),
+                         cache.page_row(sid)[:n_pages]))
+        lens.append(n)
+    if not seq_ids:
+        return [], []
+    # One gather over the union of (group, page) coordinates, sliced
+    # ON DEVICE before pulling to host: np.asarray(pool) would
+    # materialize the ENTIRE pool; this transfers only the batch's
+    # own pages, once.
+    groups = np.concatenate([np.full(len(p), g, np.int32)
+                             for g, p in pages_of]) \
+        if any(len(p) for _g, p in pages_of) else np.zeros(0, np.int32)
+    pages = np.concatenate([p for _g, p in pages_of]) \
+        if groups.size else np.zeros(0, np.int32)
+    k_all = np.asarray(cache.k_pages[groups, :, :, pages])
+    v_all = np.asarray(cache.v_pages[groups, :, :, pages])
+    ks, vs = [], []
+    off = 0
+    ps = cache.cfg.page_size
+    for (_g, p), n in zip(pages_of, lens):
+        kseq = k_all[off:off + len(p)]        # (p, L, Hkv, ps, hd)
+        vseq = v_all[off:off + len(p)]
+        off += len(p)
+        L = cache.cfg.n_layers
+        Hkv = cache.cfg.n_kv_heads
+        hd = cache.cfg.head_dim
+        k = kseq.transpose(1, 2, 0, 3, 4).reshape(
+            L, Hkv, len(p) * ps, hd)[:, :, :n]
+        v = vseq.transpose(1, 2, 0, 3, 4).reshape(
+            L, Hkv, len(p) * ps, hd)[:, :, :n]
+        ks.append(k)
+        vs.append(v)
+    return ks, vs
 
 
 def import_kv(cache, seq_id, k, v) -> None:
     """Write dense (L, Hkv, len, hd) KV into a (different) cache's
-    pages for ``seq_id`` (already joined; pages are ensured here).
-    The destination pool's sharding resharding happens in the
-    ``.at[].set`` device_puts — kv-head layout follows the
-    destination mesh."""
-    n = k.shape[2]
-    if n == 0:
-        return
-    if not cache.ensure(seq_id, n):
-        raise RuntimeError(
-            f"KV import for {seq_id!r}: destination pool cannot hold "
-            f"{n} positions")
+    pages for ``seq_id`` (already joined; pages are ensured here —
+    in the sequence's own dp group's shard). The destination pool's
+    sharding resharding happens in the ``.at[].set`` device_puts —
+    kv-head/group layout follows the destination mesh."""
+    import_kv_batch(cache, [(seq_id, k, v)])
+
+
+def import_kv_batch(cache, items) -> None:
+    """Batched page-granular import: ``items`` is a list of
+    ``(seq_id, k, v)`` dense KV triples (every seq already joined).
+    All pages across all sequences land in ONE scatter per pool —
+    the per-engine-step transfer the continuous handoff batches,
+    instead of one device round-trip per request. Raises when a
+    destination group's shard cannot hold a sequence, and the raise
+    aborts the WHOLE batch before the scatter: nothing is written
+    and no cursor advances, but earlier items' pages are left
+    allocated-and-empty (ensure() is atomic per sequence). Callers
+    must free every item and retry — ``Engine.adopt_batch`` does."""
+    todo = []
     ps = cache.cfg.page_size
-    table = cache._tables[seq_id]
-    kp, vp = cache.k_pages, cache.v_pages
-    for j, pid in enumerate(table[: -(-n // ps)]):
-        lo, hi = j * ps, min((j + 1) * ps, n)
-        kc = np.zeros((k.shape[0], k.shape[1], ps, k.shape[3]),
-                      k.dtype)
-        vc = kc.copy()
-        kc[:, :, :hi - lo] = k[:, :, lo:hi]
-        vc[:, :, :hi - lo] = v[:, :, lo:hi]
-        kp = kp.at[:, :, pid].set(kc)
-        vp = vp.at[:, :, pid].set(vc)
+    for seq_id, k, v in items:
+        n = k.shape[2]
+        if n == 0:
+            continue
+        if not cache.ensure(seq_id, n):
+            raise RuntimeError(
+                f"KV import for {seq_id!r}: destination pool cannot "
+                f"hold {n} positions")
+        todo.append((seq_id, k, v, n))
+    if not todo:
+        return
+    groups, pages, k_chunks, v_chunks = [], [], [], []
+    for seq_id, k, v, n in todo:
+        g = cache.group_of(seq_id)
+        table = cache._tables[seq_id]
+        for j, pid in enumerate(table[: -(-n // ps)]):
+            lo, hi = j * ps, min((j + 1) * ps, n)
+            kc = np.zeros((k.shape[0], k.shape[1], ps, k.shape[3]),
+                          k.dtype)
+            vc = kc.copy()
+            kc[:, :, :hi - lo] = k[:, :, lo:hi]
+            vc[:, :, :hi - lo] = v[:, :, lo:hi]
+            groups.append(g)
+            pages.append(pid)
+            k_chunks.append(kc)
+            v_chunks.append(vc)
+    gi = np.asarray(groups, np.int32)
+    pi = np.asarray(pages, np.int32)
+    kp = cache.k_pages.at[gi, :, :, pi].set(np.stack(k_chunks))
+    vp = cache.v_pages.at[gi, :, :, pi].set(np.stack(v_chunks))
     cache.update_pools(kp, vp)
-    cache.advance(seq_id, n)
+    for seq_id, _k, _v, n in todo:
+        cache.advance(seq_id, n)
 
 
 # ---------------------------------------------------------------------------
@@ -224,17 +288,28 @@ def engine_config_for_plan(plan, page_size: int = 16,
                            prefill_chunk: int = 16) -> EngineConfig:
     """The ONE engine geometry a plan implies — shared by the bench,
     the disagg pipeline, and the analysis audit target so they all
-    compile the same program shapes (``batch_per_shard`` is the
-    decode slot count; the pool covers every slot at full length)."""
+    compile the same program shapes. ``batch_per_shard`` is the
+    AGGREGATE decode slot count, dealt over the plan's ``dp`` groups
+    (serving/engine.py); ``num_pages`` is each group's pool shard,
+    sized so its own slots fit at full length — the whole-pool total
+    is the same HBM the replicated-table engine reserved, now
+    batch-sharded."""
     slots = plan.batch_per_shard
+    dp = plan.mesh.get("dp", 1)
+    if slots % dp:
+        raise ValueError(
+            f"plan '{plan.name}': batch_per_shard ({slots}) does not "
+            f"deal over dp={dp} — the planner must not emit this "
+            "(slots%dp feasibility)")
     pages_per_seq = -(-plan.seq_len // page_size)
     return EngineConfig(
         max_batch=slots,
         page_size=page_size,
-        num_pages=slots * pages_per_seq + 1,
+        num_pages=(slots // dp) * pages_per_seq + 1,
         max_seq_len=plan.seq_len,
         prefill_chunk=prefill_chunk,
-        kv_axis="tp")
+        kv_axis="tp",
+        dp_axis="dp")
 
 
 class DisaggPipeline:
@@ -326,6 +401,78 @@ class DisaggPipeline:
                    if r["id"] == req_id)
         return rec["tokens"]
 
+    def generate_many(self, requests, max_steps: int = 100_000
+                      ) -> dict:
+        """CONTINUOUS KV handoff at rate: drive many requests through
+        the pair with page transfers batched per engine step and
+        overlapped with ongoing decode, instead of one synchronous
+        transfer per request (``generate``'s shape).
+
+        Per loop iteration: the prefill slice takes one step (its own
+        continuous batch of prompts); every sequence that finished
+        its prompt THIS step is exported in ONE batched device→host
+        gather, adopted into the decode slice in ONE batched scatter
+        (``export_kv_batch``/``import_kv_batch``), and the decode
+        slice takes one step for everything already adopted — so
+        handoffs for late prompts ride alongside decode for early
+        ones. A handoff the decode slice cannot absorb yet
+        (slots/pages) is held and retried next iteration —
+        backpressure, not failure.
+
+        ``requests`` is a list of Requests; returns
+        ``{req_id: tokens}``, token-identical to the per-request path
+        (pinned by test)."""
+        pe, de = self.prefill_engine, self.decode_engine
+        for r in requests:
+            pe.submit(r)
+        want = {r.id for r in requests}
+        held: list = []       # handoffs awaiting decode capacity
+        for _ in range(max_steps):
+            done = {r["id"]: r["tokens"] for r in de.completed}
+            # Finished-on-prefill requests (<= chunk prompts whose
+            # first token IS the last token) complete on pe.
+            done.update({r["id"]: r["tokens"] for r in pe.completed
+                         if r["id"] in want})
+            if want <= set(done):
+                return {rid: done[rid] for rid in want}
+            if not pe.idle:
+                pe.step()
+            # Collect every sequence that completed its prompt —
+            # batch their exports into one transfer.
+            ready = [s for s in pe.slots
+                     if s is not None and s.prefill_done]
+            if ready:
+                ids = [s.req.id for s in ready]
+                ks, vs = export_kv_batch(pe.cache, ids)
+                for s, k, v in zip(ready, ks, vs):
+                    held.append((s.req, s.generated[0], k, v))
+                    pe.cache.free(s.req.id)
+                    pe.slots[s.slot] = None
+            if held:
+                try:
+                    de.adopt_batch(held)
+                    held = []
+                except RuntimeError:
+                    # Decode slice cannot take the WHOLE batch
+                    # (adopt_batch is all-or-nothing): adopt whatever
+                    # fits one-by-one, hold the rest for the next
+                    # iteration — backpressure must make partial
+                    # progress or a burst larger than the decode
+                    # table would livelock.
+                    still = []
+                    for item in held:
+                        try:
+                            de.adopt_batch([item])
+                        except RuntimeError:
+                            still.append(item)
+                    held = still
+            if not de.idle:
+                de.step()
+        raise RuntimeError(
+            f"disagg pipeline not drained after {max_steps} steps "
+            f"({len(held)} handoff(s) held, prefill idle={pe.idle}, "
+            f"decode idle={de.idle})")
+
 
 # ---------------------------------------------------------------------------
 # Stage-2 verifier for serving-objective plans
@@ -334,15 +481,16 @@ class DisaggPipeline:
 
 def lower_serving_program(plan, objective: str):
     """Abstractly lower the engine's compiled program for ``plan``
-    (objective "decode" → the whole-batch decode program; "prefill"
-    → the paged continuation-chunk program) on a fake CPU mesh with
-    params laid out per the plan. Returns ``(lowered, mesh)`` — no
-    state materialized (ShapeDtypeStruct inputs carrying the plan's
-    NamedShardings, analysis/compile.py's discipline). Shared by the
-    planner's stage-2 serving verifier and the analysis audit target
-    so the verified program and the ratcheted program can never
-    drift."""
-    import functools
+    (objective "decode" → the dp-sharded group-batched decode
+    program; "prefill" → the paged continuation-chunk program) on a
+    fake CPU mesh with params laid out per the plan. Returns
+    ``(lowered, mesh)`` — no state materialized (ShapeDtypeStruct
+    inputs carrying the plan's NamedShardings, analysis/compile.py's
+    discipline). The program itself comes from the SAME builders the
+    engine compiles (serving/engine.py ``build_decode_fn``/
+    ``build_prefill_fn``), so the verified program and the served
+    program can never drift — shard_map over dp included."""
+    import dataclasses
 
     import jax
     import jax.numpy as jnp
@@ -358,7 +506,8 @@ def lower_serving_program(plan, objective: str):
                           **{a: s for a, s in plan.mesh.items()
                              if s > 1})
     mesh = rt.mesh
-    ecfg = engine_config_for_plan(plan)
+    ecfg = dataclasses.replace(engine_config_for_plan(plan),
+                               paged_impl="ref")
     c = model.cfg
     params_shapes = jax.eval_shape(model.init, jax.random.PRNGKey(0))
     shardings = plan_shardings(plan, mesh, params_shapes)
@@ -368,35 +517,34 @@ def lower_serving_program(plan, objective: str):
         params_shapes, shardings)
     sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
     kv_ax = "tp" if sizes.get("tp", 1) > 1 else None
-    pool_shard = NamedSharding(mesh, P(None, kv_ax))
+    dp_ax = "dp" if sizes.get("dp", 1) > 1 else None
+    G = sizes.get("dp", 1)
+    B = ecfg.max_batch // G
+    pool_shard = NamedSharding(mesh, P(dp_ax, None, kv_ax))
     pool = jax.ShapeDtypeStruct(
-        (c.n_layers, c.n_kv_heads, ecfg.num_pages, ecfg.page_size,
+        (G, c.n_layers, c.n_kv_heads, ecfg.num_pages, ecfg.page_size,
          c.head_dim), jnp.dtype(c.dtype), sharding=pool_shard)
     rep = NamedSharding(mesh, P())
-    B = ecfg.max_batch
+    grp = NamedSharding(mesh, P(dp_ax))
     Ppages = -(-ecfg.max_seq_len // ecfg.page_size)
 
-    def arr(shape, dtype):
-        return jax.ShapeDtypeStruct(shape, dtype, sharding=rep)
+    def arr(shape, dtype, sh=rep):
+        return jax.ShapeDtypeStruct(shape, dtype, sharding=sh)
 
     if objective == "decode":
-        fn = jax.jit(
-            functools.partial(_decode_program, cfg=c, temperature=0.0,
-                              top_k=0, paged_impl="ref"),
-            donate_argnums=(1, 2))
-        args = (params, pool, pool, arr((B,), jnp.int32),
-                arr((B,), jnp.int32), arr((B, Ppages), jnp.int32),
-                arr((B,), jnp.bool_), arr((2,), jnp.uint32))
+        fn = build_decode_fn(c, ecfg, mesh=mesh)
+        args = (params, pool, pool, arr((G, B), jnp.int32, grp),
+                arr((G, B), jnp.int32, grp),
+                arr((G, B, Ppages), jnp.int32, grp),
+                arr((G, B), jnp.bool_, grp),
+                arr((G, 2), jnp.uint32, grp))
     else:
-        fn = jax.jit(
-            functools.partial(_prefill_program, cfg=c, first=False,
-                              paged_impl="ref"),
-            donate_argnums=(1, 2))
-        args = (params, pool, pool,
+        fn = build_prefill_fn(c, ecfg, first=False, mesh=mesh)
+        args = (params, pool, pool, arr((G, Ppages), jnp.int32, grp),
+                arr((G,), jnp.bool_, grp),
                 arr((1, ecfg.prefill_chunk), jnp.int32),
                 jax.ShapeDtypeStruct((), jnp.int32, sharding=rep),
-                jax.ShapeDtypeStruct((), jnp.int32, sharding=rep),
-                arr((Ppages,), jnp.int32))
+                jax.ShapeDtypeStruct((), jnp.int32, sharding=rep))
     return fn.lower(*args), mesh
 
 

@@ -1,4 +1,4 @@
-"""Continuous-batching engine: admission queue → two jitted programs.
+"""Continuous-batching engine: admission queue → three jitted programs.
 
 The serving hot loop. Requests join and leave the running batch at
 every step (continuous batching — no head-of-line blocking behind the
@@ -10,14 +10,30 @@ programs whose shapes never change:
   ops.attention), KV written into the sequence's pages;
 - **prefill, continuation chunk** — later chunks attend the pages
   written so far plus themselves (ops/paged_attention.py chunk form);
-- **decode** — ONE token for the whole slot table (max_batch wide)
-  against the paged pool, inactive slots masked and their writes
-  pointed at the scratch page.
+- **decode** — ONE token for the whole slot table, BATCH-SHARDED over
+  the mesh's ``dp`` axis: the table's ``max_batch`` slots are dealt
+  into ``dp`` groups of ``max_batch/dp``, each group decoding only its
+  own slots against its own KV pool shard. The program is a
+  ``shard_map`` manual over ``dp`` (every other mesh axis — ``tp``'s
+  head shard in particular — stays under the SPMD partitioner via the
+  ``auto`` axes), so decode rows never cross groups: aggregate decode
+  throughput scales with dp while per-token latency stays flat, and
+  dp adds ZERO new collectives (rows are independent).
 
 Join/evict therefore never change a traced shape: admission fills a
-slot and allocates pages; completion frees them; the programs compile
-once at warmup and never again (``compile_counts`` exposes the jit
-cache sizes so the bench can ASSERT zero recompiles mid-storm).
+slot in ONE group and allocates pages from that group's shard;
+completion frees them; the programs compile once at warmup and never
+again (``compile_counts`` exposes the jit cache sizes so the bench can
+ASSERT zero recompiles mid-storm).
+
+Admission is dp-aware: the queue load-balances across groups —
+fewest-active-slots-first, pages permitting — so a burst cannot pile
+onto one shard while the others idle (pinned by test under a skewed
+arrival burst). Prefill runs one sequence per step as before; the
+chunk computation is replicated across dp groups (the SAME weights on
+every group — no cheaper layout exists for one sequence) but only the
+owning group's pool shard receives live writes (the others' land in
+their scratch page) and only its logits row is read.
 
 Scheduling policy (``EngineConfig.policy``):
 
@@ -27,13 +43,21 @@ Scheduling policy (``EngineConfig.policy``):
   when no sequence can decode — best per-token latency, TTFT suffers.
 
 ``prefill_chunk`` is the per-step prefill token budget (one chunk per
-step); decode emits up to ``max_batch`` tokens per step.
+step); decode emits up to ``max_batch`` tokens per step (all groups
+fire in one program launch).
 
 Sampling is greedy at ``temperature == 0`` (the parity-tested path —
 token-for-token equal to full-context argmax); ``temperature > 0``
-samples per-slot from a per-step folded key. Batch-composition
-independence (a sequence's tokens don't depend on who shares the
-batch) is exact for greedy decoding and pinned by test.
+samples per-slot from a per-(step, group) folded key. Batch-
+composition independence (a sequence's tokens don't depend on who
+shares the batch OR which group it was dealt into) is exact for
+greedy decoding and pinned by test.
+
+Token streaming: ``add_token_listener(req_id, fn)`` registers a
+callback fired as ``fn(token, done)`` the moment each token is
+sampled — the HTTP server's chunked ``"stream": true`` path rides
+this (serving/server.py); listener failures are isolated from the
+step loop.
 
 MoE models are rejected at construction: expert dispatch has no
 serving decode path yet.
@@ -42,6 +66,7 @@ serving decode path yet.
 from __future__ import annotations
 
 import collections
+import logging
 import time
 from dataclasses import dataclass, field
 
@@ -53,16 +78,23 @@ from distributed_training_tpu.serving.kv_cache import (
 )
 from distributed_training_tpu.telemetry import event
 
+logger = logging.getLogger(__name__)
+
 _STACKED = ("ln1", "ln2", "attn", "mlp")
 
 
 @dataclass(frozen=True)
 class EngineConfig:
-    """Engine knobs (mirrored by ``conf/serving/default.yaml``)."""
+    """Engine knobs (mirrored by ``conf/serving/default.yaml``).
 
-    max_batch: int = 8            # decode slot count
+    ``max_batch`` is the AGGREGATE decode slot count across all dp
+    groups; on a mesh whose ``dp_axis`` has extent G it must divide
+    into G equal group-local tables. ``num_pages`` is the per-group
+    pool shard size (serving/kv_cache.py)."""
+
+    max_batch: int = 8            # decode slots, aggregate over dp
     page_size: int = 16
-    num_pages: int = 128
+    num_pages: int = 128          # per dp group
     max_seq_len: int = 256        # per-sequence cap (prompt + new)
     prefill_chunk: int = 32       # tokens per prefill step
     policy: str = "prefill"       # "prefill" | "decode" priority
@@ -70,6 +102,7 @@ class EngineConfig:
     top_k: int = 0
     seed: int = 0
     kv_axis: str = "tp"           # pool kv-head shard axis
+    dp_axis: str = "dp"           # slot-table / pool batch shard axis
     paged_impl: str = "auto"      # ops/paged_attention dispatch
 
     def __post_init__(self):
@@ -96,7 +129,7 @@ class Request:
 @dataclass
 class _Seq:
     req: Request
-    slot: int
+    slot: int                     # global slot id (group * B_local + i)
     prefilled: int = 0            # prompt tokens consumed so far
     generated: list = field(default_factory=list)
     first_token_t: float | None = None
@@ -147,15 +180,125 @@ def _layer_norm(x, scale, bias):
     return (y * scale + bias).astype(dtype)
 
 
+# ---------------------------------------------------------------------------
+# Program builders (shared by the engine and the planner's stage-2
+# serving verifier, serving/disagg.py — the verified program and the
+# served program are constructed HERE, once, so they cannot drift)
+# ---------------------------------------------------------------------------
+
+
+def _dp_extent(mesh, dp_axis: str) -> int:
+    if mesh is None:
+        return 1
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    return sizes.get(dp_axis, 1)
+
+
+def _sharded(body, mesh, dp_axis: str, n_grouped: int,
+             n_replicated: int, n_outs: int):
+    """Wrap a group-local program body in a shard_map manual over the
+    dp axis. Argument order contract: ``params`` first, then
+    ``n_grouped`` group-batched arrays (leading dp-group dim, spec
+    P(dp)), then ``n_replicated`` replicated args; all ``n_outs``
+    outputs are group-batched. Every OTHER mesh axis is an ``auto``
+    axis — tp's head shard (params + pool kv-head dim) stays under
+    the SPMD partitioner exactly as in the unsharded engine."""
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    grouped = P(dp_axis)
+    in_specs = ((P(),) + (grouped,) * n_grouped
+                + (P(),) * n_replicated)
+    out_specs = (grouped,) * n_outs
+    return shard_map(
+        body, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_rep=False,
+        auto=frozenset(mesh.axis_names) - {dp_axis})
+
+
+def _out_shardings(model_cfg, ecfg: EngineConfig, mesh):
+    """(per-group result sharding, pool sharding) for the jitted
+    programs' ``out_shardings``. Pinning these is load-bearing:
+    shard_map's out_specs only fix the MANUAL dp axis, so without an
+    explicit jit-level constraint the pool's tp (auto-axis) layout
+    could drift between warmup and the storm and force a mid-storm
+    recompile. One resolution shared with the cache's device_put
+    (kv_cache.pool_sharding)."""
+    from distributed_training_tpu.serving.kv_cache import (
+        pool_sharding)
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    if mesh is None:
+        return None, None
+    G = _dp_extent(mesh, ecfg.dp_axis)
+    pool = pool_sharding(mesh, model_cfg.n_kv_heads, G,
+                         ecfg.kv_axis, ecfg.dp_axis)
+    grp = NamedSharding(mesh, P(ecfg.dp_axis if G > 1 else None))
+    return grp, pool
+
+
+def build_decode_fn(model_cfg, ecfg: EngineConfig, mesh=None):
+    """The jitted dp-sharded decode program for (model, engine cfg,
+    mesh). Signature (all group-batched, G = dp extent, B = group-
+    local slots): ``fn(params, k_pages, v_pages, tokens (G, B),
+    positions (G, B), page_tables (G, B, P), active (G, B), rng_data
+    (G, 2)) -> (next_tokens (G, B), k_pages, v_pages)``. Pools are
+    donated (serving HBM's dominant term must not hold two copies)."""
+    import functools
+
+    import jax
+
+    body = functools.partial(
+        _decode_program, cfg=model_cfg,
+        temperature=ecfg.temperature, top_k=ecfg.top_k,
+        paged_impl=ecfg.paged_impl)
+    kw = {}
+    if mesh is not None:
+        grp, pool = _out_shardings(model_cfg, ecfg, mesh)
+        kw["out_shardings"] = (grp, pool, pool)
+    if _dp_extent(mesh, ecfg.dp_axis) > 1:
+        body = _sharded(body, mesh, ecfg.dp_axis,
+                        n_grouped=7, n_replicated=0, n_outs=3)
+    return jax.jit(body, donate_argnums=(1, 2), **kw)
+
+
+def build_prefill_fn(model_cfg, ecfg: EngineConfig, first: bool,
+                     mesh=None):
+    """The jitted prefill program (first or continuation chunk).
+    Signature: ``fn(params, k_pages, v_pages, page_row (G, P),
+    live (G,), chunk (1, C), start_pos, n_valid) -> (logits (G, V),
+    k_pages, v_pages)``. The chunk is replicated across groups; only
+    the ``live`` group's pool shard takes real writes (the rest land
+    in scratch) and only its logits row is meaningful for
+    continuation chunks."""
+    import functools
+
+    import jax
+
+    body = functools.partial(
+        _prefill_program, cfg=model_cfg, first=first,
+        paged_impl=ecfg.paged_impl)
+    kw = {}
+    if mesh is not None:
+        grp, pool = _out_shardings(model_cfg, ecfg, mesh)
+        kw["out_shardings"] = (grp, pool, pool)
+    if _dp_extent(mesh, ecfg.dp_axis) > 1:
+        body = _sharded(body, mesh, ecfg.dp_axis,
+                        n_grouped=4, n_replicated=3, n_outs=3)
+    return jax.jit(body, donate_argnums=(1, 2), **kw)
+
+
 class Engine:
     """The continuous-batching engine over one model + weight set.
 
     ``params`` should already be placed (serving/disagg.py
     ``place_params`` for a planned layout); ``mesh`` shards the KV
-    pool's kv-head axis over ``cfg.kv_axis`` when that axis has
-    extent > 1. ``telemetry`` rides the ambient sink
+    pool's kv-head axis over ``cfg.kv_axis`` and the slot table +
+    pool's group axis over ``cfg.dp_axis`` (each axis when its extent
+    is > 1). ``telemetry`` rides the ambient sink
     (telemetry/events.py) — every step emits a ``serving`` record the
-    metrics endpoint folds into the ``dtt_serving_*`` gauges.
+    metrics endpoint folds into the ``dtt_serving_*`` gauges,
+    per-group stats included.
     """
 
     def __init__(self, model, params, cfg: EngineConfig,
@@ -174,6 +317,13 @@ class Engine:
         self.cfg = cfg
         self.mesh = mesh
         self.params = params
+        self.dp_groups = _dp_extent(mesh, cfg.dp_axis)
+        if cfg.max_batch % self.dp_groups:
+            raise ValueError(
+                f"max_batch ({cfg.max_batch}) must divide over the "
+                f"{self.dp_groups} dp group(s) — the slot table is "
+                "dealt into equal group-local tables")
+        self.batch_local = cfg.max_batch // self.dp_groups
         self.cache = PagedKVCache(
             PagedCacheConfig(
                 n_layers=model.cfg.n_layers,
@@ -182,40 +332,33 @@ class Engine:
                 page_size=cfg.page_size,
                 num_pages=cfg.num_pages,
                 max_seq_len=cfg.max_seq_len,
-                dtype=model.cfg.dtype),
-            mesh=mesh, kv_axis=cfg.kv_axis)
+                dtype=model.cfg.dtype,
+                dp_groups=self.dp_groups),
+            mesh=mesh, kv_axis=cfg.kv_axis, dp_axis=cfg.dp_axis)
         self.queue: collections.deque[Request] = collections.deque()
         self.slots: list[_Seq | None] = [None] * cfg.max_batch
         self.completed: list[dict] = []
         self._step_counter = 0
         self._base_rng = jax.random.PRNGKey(cfg.seed)
+        self._token_listeners: dict[str, object] = {}
         self._build_programs()
+        # Greedy decode never reads the rng operand — fold_in/
+        # key_data are ~5 device dispatches PER STEP, and on the CPU
+        # mesh that was ~40% of the decode step's wall clock
+        # (SERVING_r02's dispatch-bound profile). One cached zero key
+        # per group replaces them when temperature == 0.
+        import jax.numpy as jnp
+        self._zero_rng = jnp.zeros((self.dp_groups, 2), jnp.uint32)
 
     # -- jitted programs ---------------------------------------------------
 
     def _build_programs(self) -> None:
-        import functools
-
-        import jax
-
         c = self.model.cfg
-        # Donate the pools: the decode/prefill programs functionally
-        # update arrays that dominate serving HBM — without donation
-        # every step would hold two live copies of the whole pool.
-        self._decode_fn = jax.jit(
-            functools.partial(_decode_program, cfg=c,
-                              temperature=self.cfg.temperature,
-                              top_k=self.cfg.top_k,
-                              paged_impl=self.cfg.paged_impl),
-            donate_argnums=(1, 2))
-        self._prefill_first_fn = jax.jit(
-            functools.partial(_prefill_program, cfg=c, first=True,
-                              paged_impl=self.cfg.paged_impl),
-            donate_argnums=(1, 2))
-        self._prefill_cont_fn = jax.jit(
-            functools.partial(_prefill_program, cfg=c, first=False,
-                              paged_impl=self.cfg.paged_impl),
-            donate_argnums=(1, 2))
+        self._decode_fn = build_decode_fn(c, self.cfg, self.mesh)
+        self._prefill_first_fn = build_prefill_fn(
+            c, self.cfg, first=True, mesh=self.mesh)
+        self._prefill_cont_fn = build_prefill_fn(
+            c, self.cfg, first=False, mesh=self.mesh)
 
     def compile_counts(self) -> dict:
         """Jit-cache sizes per program — the bench's zero-recompile
@@ -228,27 +371,32 @@ class Engine:
 
     def warmup(self) -> dict:
         """Compile all three programs against scratch-only page rows
-        (zero allocator side effects: every write lands in the
-        scratch page). Returns compile_counts()."""
+        (zero allocator side effects: every write lands in each
+        group's scratch page). Returns compile_counts()."""
         import jax.numpy as jnp
 
-        B, P = self.cfg.max_batch, self.cache.cfg.pages_per_seq
+        G, B = self.dp_groups, self.batch_local
+        P = self.cache.cfg.pages_per_seq
         C = self.cfg.prefill_chunk
-        zrows = jnp.zeros((B, P), jnp.int32)
-        toks = jnp.zeros((B,), jnp.int32)
-        pos = jnp.zeros((B,), jnp.int32)
-        act = jnp.zeros((B,), jnp.bool_)
-        rng = jnp.zeros((2,), jnp.uint32)
+        zrows = jnp.zeros((G, B, P), jnp.int32)
+        toks = jnp.zeros((G, B), jnp.int32)
+        pos = jnp.zeros((G, B), jnp.int32)
+        act = jnp.zeros((G, B), jnp.bool_)
+        rng = jnp.zeros((G, 2), jnp.uint32)
         _t, k, v = self._decode_fn(self.params, self.cache.k_pages,
                                    self.cache.v_pages, toks, pos,
                                    zrows, act, rng)
         self.cache.update_pools(k, v)
         ctoks = jnp.zeros((1, C), jnp.int32)
-        row = jnp.zeros((P,), jnp.int32)
+        row = jnp.zeros((G, P), jnp.int32)
+        live = jnp.zeros((G,), jnp.bool_)
         for fn in (self._prefill_first_fn, self._prefill_cont_fn):
+            # Plain-int scalars, matching the step loop's calls —
+            # a jnp.int32() here would warm a DIFFERENT (non-weak)
+            # jit entry than the one the storm hits.
             _lg, k, v = fn(self.params, self.cache.k_pages,
-                           self.cache.v_pages, ctoks,
-                           jnp.int32(0), jnp.int32(1), row)
+                           self.cache.v_pages, row, live, ctoks,
+                           0, 1)
             self.cache.update_pools(k, v)
         return self.compile_counts()
 
@@ -273,6 +421,29 @@ class Engine:
         self._validate(req)
         self.queue.append(req)
 
+    def add_token_listener(self, req_id: str, fn) -> None:
+        """Register ``fn(token: int, done: bool)`` to fire as each of
+        ``req_id``'s tokens is sampled (the HTTP streaming path).
+        Dropped automatically when the request completes; listener
+        exceptions are logged, never raised into the step loop."""
+        self._token_listeners[req_id] = fn
+
+    def remove_token_listener(self, req_id: str) -> None:
+        self._token_listeners.pop(req_id, None)
+
+    def _emit_token(self, seq: _Seq, token: int) -> None:
+        fn = self._token_listeners.get(seq.req.id)
+        if fn is None:
+            return
+        try:
+            fn(int(token), seq.done)
+        except Exception:
+            logger.exception("token listener for %r failed; "
+                             "dropping it", seq.req.id)
+            self._token_listeners.pop(seq.req.id, None)
+        if seq.done:
+            self._token_listeners.pop(seq.req.id, None)
+
     @property
     def in_flight(self) -> int:
         return sum(1 for s in self.slots if s is not None)
@@ -281,27 +452,59 @@ class Engine:
     def idle(self) -> bool:
         return not self.queue and self.in_flight == 0
 
-    def _free_slot(self) -> int | None:
-        for i, s in enumerate(self.slots):
-            if s is None:
+    def group_of_slot(self, slot: int) -> int:
+        return slot // self.batch_local
+
+    def slots_active_by_group(self) -> list[int]:
+        B = self.batch_local
+        return [sum(1 for s in self.slots[g * B:(g + 1) * B]
+                    if s is not None)
+                for g in range(self.dp_groups)]
+
+    def _free_slot(self, group: int | None = None) -> int | None:
+        B = self.batch_local
+        if group is None:
+            for i, s in enumerate(self.slots):
+                if s is None:
+                    return i
+            return None
+        for i in range(group * B, (group + 1) * B):
+            if self.slots[i] is None:
                 return i
         return None
 
+    def _pick_group(self, first_tokens: int) -> tuple[int, int] | None:
+        """Admission load balancing: the fewest-active-slots group
+        (ties to the lowest index) that has BOTH a free slot and pages
+        for the first chunk. None = every group is full/backpressured
+        (the request stays queued)."""
+        active = self.slots_active_by_group()
+        order = sorted(range(self.dp_groups),
+                       key=lambda g: (active[g], g))
+        for g in order:
+            slot = self._free_slot(g)
+            if slot is None:
+                continue
+            if not self.cache.can_admit(first_tokens, group=g):
+                continue
+            return g, slot
+        return None
+
     def _admit(self) -> _Seq | None:
-        """Move the head-of-queue request into a slot, pages for its
-        FIRST chunk allocated. None when no slot/pages are free
-        (backpressure — the request stays queued)."""
+        """Move the head-of-queue request into the least-loaded
+        group's free slot, pages for its FIRST chunk allocated. None
+        when no group has slot+pages (backpressure — the request
+        stays queued)."""
         if not self.queue:
-            return None
-        slot = self._free_slot()
-        if slot is None:
             return None
         req = self.queue[0]
         first = min(req.prompt.shape[0], self.cfg.prefill_chunk)
-        if not self.cache.can_admit(first):
+        picked = self._pick_group(first)
+        if picked is None:
             return None
+        group, slot = picked
         self.queue.popleft()
-        self.cache.join(req.id)
+        self.cache.join(req.id, group=group)
         self.cache.ensure(req.id, first)
         seq = _Seq(req=req, slot=slot)
         self.slots[slot] = seq
@@ -354,14 +557,28 @@ class Engine:
                "in_flight": self.in_flight,
                "queue_depth": len(self.queue),
                **self.cache.occupancy()}
+        if self.dp_groups > 1:
+            rec["group_slots_active"] = self.slots_active_by_group()
         event("serving", **rec)
         self._step_counter += 1
         return rec
 
+    def _group_row(self, seq_id) -> tuple[np.ndarray, np.ndarray, int]:
+        """(G, P) page rows + (G,) live mask for a single sequence:
+        the owner group's real row, all-scratch rows elsewhere."""
+        G = self.dp_groups
+        g = self.cache.group_of(seq_id)
+        rows = np.zeros((G, self.cache.cfg.pages_per_seq), np.int32)
+        rows[g] = self.cache.page_row(seq_id)
+        live = np.zeros((G,), bool)
+        live[g] = True
+        return rows, live, g
+
     def _run_prefill_chunk(self, seq: _Seq) -> bool:
         """One chunk of ``seq``'s prompt. False = no progress (the
-        pool could not cover the chunk's pages — backpressure; the
-        caller must let decode run so pages free up)."""
+        owning group's pool could not cover the chunk's pages —
+        backpressure; the caller must let decode run so pages free
+        up)."""
         import jax.numpy as jnp
 
         c = self.cfg
@@ -371,21 +588,28 @@ class Engine:
             return False
         chunk = np.zeros((1, c.prefill_chunk), np.int32)
         chunk[0, :n_valid] = seq.req.prompt[start:start + n_valid]
-        row = jnp.asarray(self.cache.page_row(seq.req.id))
+        rows, live, g = self._group_row(seq.req.id)
         fn = (self._prefill_first_fn if start == 0
               else self._prefill_cont_fn)
+        # start/n_valid ride as weak-typed scalars: same jit cache
+        # entry for every value, no explicit device_put dispatches.
         logits, k, v = fn(self.params, self.cache.k_pages,
-                          self.cache.v_pages, jnp.asarray(chunk),
-                          jnp.int32(start), jnp.int32(n_valid), row)
+                          self.cache.v_pages, jnp.asarray(rows),
+                          jnp.asarray(live), jnp.asarray(chunk),
+                          start, n_valid)
         self.cache.update_pools(k, v)
         self.cache.advance(seq.req.id, n_valid)
         seq.prefilled = start + n_valid
         if seq.prefill_done:
-            tok = self._sample_host(logits)
+            # device_get the whole (G, V) block and slice on host:
+            # logits[g] on the dp-sharded array would be one more
+            # device dispatch per completed prompt.
+            tok = self._sample_host(np.asarray(logits)[g])
             now = time.monotonic()
             seq.first_token_t = now
             seq.token_times.append(now)
             seq.generated.append(tok)
+            self._emit_token(seq, tok)
             self._maybe_finish(seq)
         return True
 
@@ -397,7 +621,10 @@ class Engine:
         import jax.numpy as jnp
 
         if self.cfg.temperature <= 0:
-            return int(jnp.argmax(logits))
+            # Host argmax: one V-sized transfer instead of a device
+            # argmax dispatch + sync — on the dispatch-bound CPU
+            # mesh the extra launch was ~30% of a prefill step.
+            return int(np.asarray(logits).argmax())
         rng = jax.random.fold_in(self._base_rng,
                                  1_000_000 + self._step_counter)
         lg = logits / self.cfg.temperature
@@ -410,43 +637,54 @@ class Engine:
         import jax
         import jax.numpy as jnp
 
-        B = self.cfg.max_batch
-        tokens = np.zeros((B,), np.int32)
-        positions = np.zeros((B,), np.int32)
-        active = np.zeros((B,), bool)
-        seq_ids: list = [None] * B
+        G, B = self.dp_groups, self.batch_local
+        tokens = np.zeros((G, B), np.int32)
+        positions = np.zeros((G, B), np.int32)
+        active = np.zeros((G, B), bool)
+        seq_ids: list[list] = [[None] * B for _ in range(G)]
         stepped: list[_Seq] = []
         for s in decodable:
             # The new token's KV lands at position length(seq); make
-            # sure a page covers it. Failure = pool exhausted: the
-            # slot stalls this step and resumes when pages free.
+            # sure a page covers it. Failure = that group's pool
+            # shard is exhausted: the slot stalls this step and
+            # resumes when pages free.
             if not self.cache.ensure(s.req.id,
                                      self.cache.length(s.req.id) + 1):
                 continue
-            b = s.slot
-            tokens[b] = s.generated[-1]
-            positions[b] = self.cache.length(s.req.id)
-            active[b] = True
-            seq_ids[b] = s.req.id
+            g, i = divmod(s.slot, B)
+            tokens[g, i] = s.generated[-1]
+            positions[g, i] = self.cache.length(s.req.id)
+            active[g, i] = True
+            seq_ids[g][i] = s.req.id
             stepped.append(s)
         if not stepped:
             return 0
-        rows = self.cache.page_rows(seq_ids)
-        rng = jax.random.fold_in(self._base_rng, self._step_counter)
+        rows = self.cache.page_rows_grouped(seq_ids)
+        if self.cfg.temperature <= 0:
+            rng = self._zero_rng          # greedy: operand is dead
+        else:
+            base = jax.random.fold_in(self._base_rng,
+                                      self._step_counter)
+            rng = jnp.asarray(np.stack([
+                np.asarray(jax.random.key_data(
+                    jax.random.fold_in(base, g)))
+                for g in range(G)]))
         nxt, k, v = self._decode_fn(
             self.params, self.cache.k_pages, self.cache.v_pages,
             jnp.asarray(tokens), jnp.asarray(positions),
-            jnp.asarray(rows), jnp.asarray(active),
-            jax.random.key_data(rng))
+            jnp.asarray(rows), jnp.asarray(active), rng)
         self.cache.update_pools(k, v)
         nxt = np.asarray(nxt)
         now = time.monotonic()
         for s in stepped:
+            g, i = divmod(s.slot, B)
             self.cache.advance(s.req.id, 1)
-            s.generated.append(int(nxt[s.slot]))
+            tok = int(nxt[g, i])
+            s.generated.append(tok)
             if s.first_token_t is None:
                 s.first_token_t = now
             s.token_times.append(now)
+            self._emit_token(s, tok)
             self._maybe_finish(s)
         return len(stepped)
 
@@ -469,12 +707,13 @@ class Engine:
                        if seq.first_token_t is not None else None),
             "latency_s": now - arrival,
             "token_gaps_s": gaps,
+            "group": self.group_of_slot(seq.slot),
         }
         self.completed.append(rec)
         event("serving_request",
               **{k: rec[k] for k in ("id", "prompt_tokens",
                                      "new_tokens", "ttft_s",
-                                     "latency_s")})
+                                     "latency_s", "group")})
 
     # -- convenience -------------------------------------------------------
 
@@ -509,33 +748,62 @@ class Engine:
         """Adopt an EXTERNALLY-PREFILLED sequence (the disaggregation
         handoff, serving/disagg.py): its prompt KV arrives as dense
         (L, Hkv, prompt_len, hd) arrays and is written into this
-        engine's pages; decode continues here as if the prefill had
-        run locally. ``first_token`` is the token the prefill slice
-        sampled from its final logits."""
-        from distributed_training_tpu.serving.disagg import import_kv
+        engine's pages — into the least-loaded dp group's shard, the
+        same balancing as queue admission; decode continues here as
+        if the prefill had run locally. ``first_token`` is the token
+        the prefill slice sampled from its final logits."""
+        self.adopt_batch([(req, first_token, k_dense, v_dense)])
 
-        if req.arrival is None:
-            req.arrival = time.monotonic()
-        self._validate(req)
-        slot = self._free_slot()
-        if slot is None:
-            raise RuntimeError("no free slot to adopt into")
-        self.cache.join(req.id)
-        try:
-            import_kv(self.cache, req.id, k_dense, v_dense)
-        except Exception:
-            # A failed import must not leak the joined table entry
-            # (a retry of the same request id would hit "already
-            # joined" forever).
-            self.cache.free(req.id)
-            raise
-        seq = _Seq(req=req, slot=slot, prefilled=req.prompt.shape[0])
+    def adopt_batch(self, items) -> None:
+        """Adopt MANY externally-prefilled sequences in one batched
+        page import (serving/disagg.py ``import_kv_batch`` — a single
+        scatter per pool instead of one device round-trip per
+        request; the continuous-handoff rate path). ``items`` is a
+        list of ``(req, first_token, k_dense, v_dense)``. Raises
+        before touching the pool when any request cannot get a
+        slot+pages — the caller holds the batch and retries once
+        decode frees capacity."""
+        from distributed_training_tpu.serving.disagg import (
+            import_kv_batch)
+
         now = time.monotonic()
-        seq.first_token_t = now
-        seq.token_times.append(now)
-        seq.generated.append(int(first_token))
-        self.slots[slot] = seq
-        self._maybe_finish(seq)
+        staged = []
+        try:
+            for req, first_token, k_dense, v_dense in items:
+                if req.arrival is None:
+                    req.arrival = now
+                self._validate(req)
+                picked = self._pick_group(req.prompt.shape[0])
+                if picked is None:
+                    raise RuntimeError(
+                        f"no free slot/pages to adopt {req.id!r} "
+                        "into")
+                group, slot = picked
+                self.cache.join(req.id, group=group)
+                seq = _Seq(req=req, slot=slot,
+                           prefilled=req.prompt.shape[0])
+                self.slots[slot] = seq
+                staged.append((seq, first_token, k_dense, v_dense))
+            import_kv_batch(self.cache,
+                            [(s.req.id, k, v)
+                             for s, _t, k, v in staged])
+        except Exception:
+            # A failed batch must not leak joined table entries or
+            # slots (a retry of the same request id would hit
+            # "already joined" forever). ensure() inside the batch
+            # import is atomic per sequence, so freeing returns
+            # exactly the pages taken.
+            for s, _t, _k, _v in staged:
+                self.cache.free(s.req.id)
+                self.slots[s.slot] = None
+            raise
+        now = time.monotonic()
+        for seq, first_token, _k, _v in staged:
+            seq.first_token_t = now
+            seq.token_times.append(now)
+            seq.generated.append(int(first_token))
+            self._emit_token(seq, int(first_token))
+            self._maybe_finish(seq)
 
     def preempt(self) -> list[Request]:
         """Simulated engine preemption: drop all device-side progress,
@@ -543,7 +811,9 @@ class Engine:
         in-flight requests, fresh — generation restarts from the
         prompt, the standard continuous-batching recovery). The
         engine is reusable afterwards (a restarted incarnation calls
-        ``submit`` with these)."""
+        ``submit`` with these). Token listeners for the lost work are
+        dropped too — a resubmitted request restarts from the prompt,
+        and a stale listener would stream its early tokens twice."""
         lost: list[Request] = []
         for i, s in enumerate(self.slots):
             if s is None:
@@ -555,12 +825,17 @@ class Engine:
                                 arrival=s.req.arrival))
         lost.extend(self.queue)
         self.queue.clear()
+        for req in lost:
+            self._token_listeners.pop(req.id, None)
         event("serving_preempt", lost=len(lost))
         return lost
 
 
 # ---------------------------------------------------------------------------
-# The compiled programs (pure functions of arrays + static model cfg)
+# The compiled programs (pure functions of arrays + static model cfg).
+# Each body sees ONE dp group's block: pools (1, L, Hkv, N, ps, hd),
+# batch arrays with a leading group dim of 1 — under shard_map that is
+# the per-group shard; without a dp mesh it is the whole (only) group.
 # ---------------------------------------------------------------------------
 
 
@@ -583,13 +858,16 @@ def _write_kv(k_pages, v_pages, k_new, v_new, page_ids, offsets):
 def _decode_program(params, k_pages, v_pages, tokens, positions,
                     page_tables, active, rng_data, *, cfg,
                     temperature, top_k, paged_impl):
-    """One token for the whole slot table.
+    """One token for one dp group's slot table.
 
-    tokens (B,) int32 — last sampled token per slot; positions (B,)
-    — the ABSOLUTE position that token occupies (== kv entries
-    already written); page_tables (B, P); active (B,) bool. Returns
-    (next_tokens (B,), k_pages, v_pages). Inactive slots compute
-    garbage into the scratch page and their sampled token is 0.
+    k_pages/v_pages (1, L, Hkv, N, ps, hd) — the group's pool shard;
+    tokens (1, B) int32 — last sampled token per local slot;
+    positions (1, B) — the ABSOLUTE position that token occupies
+    (== kv entries already written); page_tables (1, B, P); active
+    (1, B) bool; rng_data (1, 2) uint32 — the group's folded key.
+    Returns (next_tokens (1, B), k_pages, v_pages). Inactive slots
+    compute garbage into the scratch page and their sampled token
+    is 0.
     """
     import jax
     import jax.numpy as jnp
@@ -597,9 +875,12 @@ def _decode_program(params, k_pages, v_pages, tokens, positions,
     from distributed_training_tpu.ops.paged_attention import (
         paged_attention)
 
+    k_pages_g, v_pages_g = k_pages[0], v_pages[0]
+    tokens, positions = tokens[0], positions[0]
+    page_tables, active = page_tables[0], active[0]
     dt = jnp.dtype(cfg.dtype)
     B = tokens.shape[0]
-    ps = k_pages.shape[3]
+    ps = k_pages_g.shape[3]
     x = params["tok_embed"][tokens].astype(dt)            # (B, D)
     if cfg.pos_encoding == "learned":
         x = x + params["pos_embed"][positions].astype(dt)
@@ -643,8 +924,8 @@ def _decode_program(params, k_pages, v_pages, tokens, positions,
                  + m["bo"].astype(dt))
         return x, (kp, vp)
 
-    x, (k_pages, v_pages) = jax.lax.scan(
-        layer_body, x, (stacked, k_pages, v_pages))
+    x, (k_pages_g, v_pages_g) = jax.lax.scan(
+        layer_body, x, (stacked, k_pages_g, v_pages_g))
     x = _layer_norm(x, params["final_norm"]["scale"],
                     params["final_norm"]["bias"])
     head = (params["tok_embed"].T if cfg.tie_embeddings
@@ -659,23 +940,27 @@ def _decode_program(params, k_pages, v_pages, tokens, positions,
             kth = jax.lax.top_k(lg, top_k)[0][..., -1:]
             lg = jnp.where(lg < kth, -jnp.inf, lg)
         keys = jax.random.split(
-            jax.random.wrap_key_data(rng_data), B)
+            jax.random.wrap_key_data(rng_data[0]), B)
         nxt = jax.vmap(jax.random.categorical)(keys, lg).astype(
             jnp.int32)
-    return jnp.where(active, nxt, 0), k_pages, v_pages
+    return (jnp.where(active, nxt, 0)[None],
+            k_pages_g[None], v_pages_g[None])
 
 
-def _prefill_program(params, k_pages, v_pages, chunk_tokens,
-                     start_pos, n_valid, page_row, *, cfg, first,
+def _prefill_program(params, k_pages, v_pages, page_row, live,
+                     chunk_tokens, start_pos, n_valid, *, cfg, first,
                      paged_impl):
-    """One prompt chunk for one sequence.
+    """One prompt chunk for one sequence, on one dp group's shard.
 
-    chunk_tokens (1, C) int32 (positions >= n_valid are padding);
-    start_pos — the chunk's first absolute position; page_row (P,) —
-    the sequence's table. Writes the chunk's KV into its pages and
-    returns (next-token logits (V,) fp32 — from the LAST VALID
-    position, meaningful when this is the prompt's final chunk —
-    k_pages, v_pages).
+    k_pages/v_pages (1, L, Hkv, N, ps, hd); page_row (1, P) — the
+    sequence's table on its OWNER group, all-scratch elsewhere; live
+    (1,) bool — True only on the owner (dead groups' writes land in
+    their scratch page and their queries mask out); chunk_tokens
+    (1, C) int32 (positions >= n_valid are padding); start_pos — the
+    chunk's first absolute position. Writes the chunk's KV into its
+    pages and returns (next-token logits (1, V) fp32 — from the LAST
+    VALID position, meaningful on the OWNER group when this is the
+    prompt's final chunk — k_pages, v_pages).
 
     ``first=True`` (start_pos == 0, traced as a separate program):
     attention is ordinary causal self-attention over the chunk
@@ -693,12 +978,14 @@ def _prefill_program(params, k_pages, v_pages, chunk_tokens,
         paged_attention_chunk)
 
     del paged_impl  # chunk form has no kernel path yet
+    k_pages_g, v_pages_g = k_pages[0], v_pages[0]
+    page_row, live = page_row[0], live[0]
     dt = jnp.dtype(cfg.dtype)
     C = chunk_tokens.shape[1]
-    ps = k_pages.shape[3]
+    ps = k_pages_g.shape[3]
     idx = jnp.arange(C, dtype=jnp.int32)
     abs_pos = start_pos + idx                             # (C,)
-    valid = idx < n_valid
+    valid = (idx < n_valid) & live
     x = params["tok_embed"][chunk_tokens[0]].astype(dt)   # (C, D)
     if cfg.pos_encoding == "learned":
         # Clamp padding positions into range; their rows are dead.
@@ -706,9 +993,11 @@ def _prefill_program(params, k_pages, v_pages, chunk_tokens,
         x = x + params["pos_embed"][safe].astype(dt)
     page_ids = jnp.where(valid, page_row[abs_pos // ps], 0)
     offsets = jnp.where(valid, abs_pos % ps, 0)
-    # Padding queries mask out of the paged form via negative
-    # positions; the causal first-chunk form never lets a valid query
-    # see a padding key (pads sit at higher positions).
+    # Padding queries — and every query on a non-live group — mask
+    # out of the paged form via negative positions; the causal
+    # first-chunk form never lets a valid query see a padding key
+    # (pads sit at higher positions) and never reads the pool, so
+    # its logits are identical on every group.
     q_pos = jnp.where(valid, abs_pos, -1)[None, :]        # (1, C)
     stacked = {k: params[k] for k in _STACKED}
 
@@ -749,8 +1038,8 @@ def _prefill_program(params, k_pages, v_pages, chunk_tokens,
                  + m["bo"].astype(dt))
         return x, (kp, vp)
 
-    x, (k_pages, v_pages) = jax.lax.scan(
-        layer_body, x, (stacked, k_pages, v_pages))
+    x, (k_pages_g, v_pages_g) = jax.lax.scan(
+        layer_body, x, (stacked, k_pages_g, v_pages_g))
     x_last = jax.lax.dynamic_index_in_dim(
         x, jnp.maximum(n_valid - 1, 0), axis=0, keepdims=False)
     x_last = _layer_norm(x_last, params["final_norm"]["scale"],
@@ -759,4 +1048,4 @@ def _prefill_program(params, k_pages, v_pages, chunk_tokens,
             else params["lm_head"])
     logits = jnp.einsum("d,dv->v", x_last,
                         head.astype(dt)).astype(jnp.float32)
-    return logits, k_pages, v_pages
+    return logits[None], k_pages_g[None], v_pages_g[None]

@@ -2,38 +2,46 @@
 
 Per-request max_len buffers waste HBM quadratically under continuous
 batching (every slot reserves the worst case); the paged layout is
-virtual memory for KV instead. One reservation of ``num_pages`` pages
-of ``page_size`` tokens each, per layer, kv-head-major:
+virtual memory for KV instead. One reservation of ``dp_groups``
+independent pool shards of ``num_pages`` pages of ``page_size`` tokens
+each, per layer, kv-head-major:
 
-    k_pages, v_pages: (n_layers, n_kv_heads, num_pages, page_size,
-                       head_dim)
+    k_pages, v_pages: (dp_groups, n_layers, n_kv_heads, num_pages,
+                       page_size, head_dim)
 
-A sequence owns an ordered list of physical page ids (its PAGE TABLE);
-logical position ``p`` lives in slot ``p % page_size`` of its
-``p // page_size``-th page. Join = allocate pages from the free list,
-evict = return them — no copying, no compaction, and the device
-arrays never change shape, so the decode program never recompiles.
+A sequence owns an ordered list of physical page ids (its PAGE TABLE)
+inside ONE dp group's shard; logical position ``p`` lives in slot
+``p % page_size`` of its ``p // page_size``-th page. Join = allocate
+pages from the group's free list, evict = return them — no copying, no
+compaction, and the device arrays never change shape, so the decode
+program never recompiles.
 
-**Page 0 is the scratch page**: never allocated, the write target for
-inactive batch slots and padding positions (the jitted decode/prefill
-programs write unconditionally; pointing dead writes at scratch keeps
-them out of live pages without dynamic shapes). Unused page-table
-entries also point at it — their slots are masked out of attention by
-position, so the garbage is never read into a softmax.
+**Page 0 of every group is that group's scratch page**: never
+allocated, the write target for inactive batch slots and padding
+positions (the jitted decode/prefill programs write unconditionally;
+pointing dead writes at scratch keeps them out of live pages without
+dynamic shapes). Unused page-table entries also point at it — their
+slots are masked out of attention by position, so the garbage is never
+read into a softmax.
 
 **Sharding**: on a multi-device mesh the pool is sharded along the
-kv-head axis over the plan's ``tp`` mesh axis (the decode plan's head
-currency — serving's analogue of the training tp head shard), and
-replicated elsewhere. Page tables/lengths are tiny int32 rows and stay
-replicated.
+LEADING dp-group axis over the plan's ``dp`` mesh axis (the decode
+engine's batch-parallel slot shard — each dp group decodes only its
+own slots against its own pool shard, serving/engine.py) and along the
+kv-head axis over the plan's ``tp`` axis (the decode plan's head
+currency), replicated elsewhere. Page tables/lengths are tiny int32
+rows and stay host-side.
 
 **Accounting**: the allocator is host-side (plain Python — allocation
-decisions are control flow, not math) and every alloc/free emits a
-``serving_kv`` telemetry record with the pool occupancy, which the
-metrics endpoint folds into ``dtt_serving_kv_pages_{used,total}``.
-Invariant (pinned by test): ``pages_used + free == num_pages - 1``
-always, and freeing every sequence returns occupancy to zero — the
-pool cannot leak under any join/evict order.
+decisions are control flow, not math), PER GROUP, and every alloc/free
+emits a ``serving_kv`` telemetry record with the pool occupancy AND
+the owning group, which the metrics endpoint folds into
+``dtt_serving_kv_pages_{used,total}`` plus the per-group labeled
+gauges. Invariant (pinned by test, per shard): for every group,
+``pages_used_in(g) + free == num_pages - 1`` always, and freeing every
+sequence returns every group's occupancy to zero — no join/evict order
+can leak a page or let one group's allocation bleed into another's
+shard.
 """
 
 from __future__ import annotations
@@ -47,15 +55,18 @@ from distributed_training_tpu.telemetry import event
 
 @dataclass(frozen=True)
 class PagedCacheConfig:
-    """Pool geometry. ``max_seq_len`` bounds pages per sequence."""
+    """Pool geometry. ``max_seq_len`` bounds pages per sequence;
+    ``num_pages`` is PER GROUP (each dp group owns its own shard of
+    ``num_pages`` pages, scratch included)."""
 
     n_layers: int
     n_kv_heads: int
     head_dim: int
     page_size: int = 16
-    num_pages: int = 128          # scratch page 0 included
+    num_pages: int = 128          # per group, scratch page 0 included
     max_seq_len: int = 256
     dtype: str = "float32"
+    dp_groups: int = 1            # leading pool dim / allocator shards
 
     def __post_init__(self):
         if self.page_size < 1:
@@ -69,6 +80,9 @@ class PagedCacheConfig:
             raise ValueError(
                 f"max_seq_len ({self.max_seq_len}) must be a multiple "
                 f"of page_size ({self.page_size})")
+        if self.dp_groups < 1:
+            raise ValueError(
+                f"dp_groups must be >= 1, got {self.dp_groups}")
 
     @property
     def pages_per_seq(self) -> int:
@@ -76,7 +90,11 @@ class PagedCacheConfig:
 
     @property
     def usable_pages(self) -> int:
-        return self.num_pages - 1  # minus scratch
+        return self.num_pages - 1  # per group, minus scratch
+
+    @property
+    def usable_pages_total(self) -> int:
+        return self.dp_groups * self.usable_pages
 
     def kv_bytes_per_token(self) -> int:
         """HBM cost of one cached token across all layers (k + v)."""
@@ -85,37 +103,58 @@ class PagedCacheConfig:
                 * itemsize)
 
 
-class PagedKVCache:
-    """The pool + its host-side allocator and per-sequence tables.
+def pool_sharding(mesh, n_kv_heads: int, dp_groups: int,
+                  kv_axis: str | None, dp_axis: str | None):
+    """The pool's NamedSharding on ``mesh`` (None when no mesh):
+    leading group dim over ``dp_axis``, kv-head dim over ``kv_axis``,
+    each when its extent > 1. ONE resolution shared by the cache's
+    device_put and the engine's program ``out_shardings``
+    (serving/engine.py) — if they disagreed, every step's donated
+    pool would come back in a different layout and the decode program
+    would recompile mid-storm."""
+    if mesh is None:
+        return None
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    kv_ax = kv_axis if kv_axis and sizes.get(kv_axis, 1) > 1 else None
+    if kv_ax is not None and n_kv_heads % sizes[kv_ax]:
+        raise ValueError(
+            f"kv pool cannot shard {n_kv_heads} kv heads over "
+            f"{kv_axis}={sizes[kv_ax]}")
+    dp_ax = dp_axis if dp_axis and sizes.get(dp_axis, 1) > 1 else None
+    if dp_ax is not None and dp_groups != sizes[dp_ax]:
+        raise ValueError(
+            f"pool has {dp_groups} dp group(s) but mesh axis "
+            f"'{dp_axis}' has extent {sizes[dp_ax]} — the allocator "
+            "groups must be the mesh's dp groups")
+    return NamedSharding(mesh, P(dp_ax, None, kv_ax))
 
-    ``mesh``/``kv_axis``: shard the pools' kv-head dim over that mesh
-    axis (skipped when the axis has extent 1 or no mesh is given).
-    The device pools are handed to the engine's jitted programs as
-    donated inputs; the engine writes the updated arrays back via
+
+class PagedKVCache:
+    """The pool + its per-group host-side allocators and page tables.
+
+    ``mesh``/``kv_axis``/``dp_axis``: shard the pools' kv-head dim
+    over ``kv_axis`` and the leading group dim over ``dp_axis``
+    (either skipped when its axis has extent 1 or no mesh is given).
+    ``cfg.dp_groups`` must equal the ``dp_axis`` extent when that axis
+    is sharded — the allocator groups ARE the mesh's dp groups. The
+    device pools are handed to the engine's jitted programs as donated
+    inputs; the engine writes the updated arrays back via
     ``update_pools`` each step.
     """
 
     def __init__(self, cfg: PagedCacheConfig, mesh=None,
-                 kv_axis: str | None = None):
+                 kv_axis: str | None = None,
+                 dp_axis: str | None = "dp"):
         import jax
         import jax.numpy as jnp
 
         self.cfg = cfg
-        shape = (cfg.n_layers, cfg.n_kv_heads, cfg.num_pages,
-                 cfg.page_size, cfg.head_dim)
+        shape = (cfg.dp_groups, cfg.n_layers, cfg.n_kv_heads,
+                 cfg.num_pages, cfg.page_size, cfg.head_dim)
         dt = jnp.dtype(cfg.dtype)
-        sharding = None
-        if mesh is not None:
-            from jax.sharding import NamedSharding, PartitionSpec as P
-            sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
-            ax = kv_axis if kv_axis and sizes.get(kv_axis, 1) > 1 \
-                else None
-            if ax is not None and cfg.n_kv_heads % sizes[ax]:
-                raise ValueError(
-                    f"kv pool cannot shard {cfg.n_kv_heads} kv heads "
-                    f"over {kv_axis}={sizes[ax]}")
-            sharding = NamedSharding(mesh, P(None, ax))
-        self.sharding = sharding
+        self.sharding = sharding = pool_sharding(
+            mesh, cfg.n_kv_heads, cfg.dp_groups, kv_axis, dp_axis)
 
         def pool():
             # Two DISTINCT buffers: k and v are donated separately to
@@ -127,57 +166,94 @@ class PagedKVCache:
 
         self.k_pages = pool()
         self.v_pages = pool()
-        # Host allocator state. Free list is LIFO: recently-freed
-        # pages are re-handed first (warm in cache, and deterministic
-        # for the tests' join/evict permutations).
-        self._free: list[int] = list(range(cfg.num_pages - 1, 0, -1))
+        # Host allocator state, PER GROUP. Free lists are LIFO:
+        # recently-freed pages are re-handed first (warm in cache, and
+        # deterministic for the tests' join/evict permutations).
+        self._frees: list[list[int]] = [
+            list(range(cfg.num_pages - 1, 0, -1))
+            for _ in range(cfg.dp_groups)]
         self._tables: dict[object, list[int]] = {}
         self._lengths: dict[object, int] = {}
+        self._groups: dict[object, int] = {}
 
     # -- allocator ---------------------------------------------------------
 
     @property
+    def _free(self) -> list[int]:
+        """Group 0's free list — the PR-13 single-pool surface, kept
+        for the unsharded (dp_groups == 1) callers and tests."""
+        if self.cfg.dp_groups != 1:
+            raise AttributeError(
+                "no single free list on a dp-sharded pool — use "
+                "free_pages_in(group)")
+        return self._frees[0]
+
+    def free_pages_in(self, group: int) -> int:
+        return len(self._frees[group])
+
+    @property
     def pages_used(self) -> int:
-        return self.cfg.usable_pages - len(self._free)
+        """Pages allocated across ALL groups."""
+        return self.cfg.usable_pages_total - sum(
+            len(f) for f in self._frees)
+
+    def pages_used_in(self, group: int) -> int:
+        return self.cfg.usable_pages - len(self._frees[group])
 
     @property
     def seqs(self) -> int:
         return len(self._tables)
 
+    def seqs_in(self, group: int) -> int:
+        return sum(1 for g in self._groups.values() if g == group)
+
     def _emit(self, op: str, seq_id) -> None:
         event("serving_kv", op=op, seq=str(seq_id),
+              group=self._groups.get(seq_id, 0),
               pages_used=self.pages_used,
-              pages_total=self.cfg.usable_pages, seqs=self.seqs)
+              pages_total=self.cfg.usable_pages_total,
+              seqs=self.seqs)
 
-    def can_admit(self, n_tokens: int) -> bool:
-        """Would ``ensure`` succeed for a NEW sequence of n_tokens?"""
+    def can_admit(self, n_tokens: int, group: int = 0) -> bool:
+        """Would ``ensure`` succeed for a NEW sequence of n_tokens in
+        ``group``?"""
         need = -(-max(1, n_tokens) // self.cfg.page_size)
-        return need <= len(self._free)
+        return need <= len(self._frees[group])
 
-    def join(self, seq_id) -> None:
+    def join(self, seq_id, group: int = 0) -> None:
         if seq_id in self._tables:
             raise KeyError(f"sequence {seq_id!r} already joined")
+        if not 0 <= group < self.cfg.dp_groups:
+            raise ValueError(
+                f"group {group} out of range (pool has "
+                f"{self.cfg.dp_groups} dp group(s))")
         self._tables[seq_id] = []
         self._lengths[seq_id] = 0
+        self._groups[seq_id] = group
         self._emit("join", seq_id)
 
+    def group_of(self, seq_id) -> int:
+        return self._groups[seq_id]
+
     def ensure(self, seq_id, n_tokens: int) -> bool:
-        """Grow seq_id's table to cover ``n_tokens`` total positions.
-        Returns False (allocating NOTHING — admission is atomic per
-        call) when the free list cannot cover the growth; the engine
-        treats that as backpressure and defers the work."""
+        """Grow seq_id's table to cover ``n_tokens`` total positions,
+        from its OWN group's free list. Returns False (allocating
+        NOTHING — admission is atomic per call) when that free list
+        cannot cover the growth; the engine treats that as
+        backpressure and defers the work."""
         if n_tokens > self.cfg.max_seq_len:
             raise ValueError(
                 f"sequence {seq_id!r} needs {n_tokens} positions, "
                 f"pool max_seq_len is {self.cfg.max_seq_len}")
         table = self._tables[seq_id]
+        free = self._frees[self._groups[seq_id]]
         need = -(-n_tokens // self.cfg.page_size) - len(table)
         if need <= 0:
             return True
-        if need > len(self._free):
+        if need > len(free):
             return False
         for _ in range(need):
-            table.append(self._free.pop())
+            table.append(free.pop())
         self._emit("grow", seq_id)
         return True
 
@@ -194,21 +270,33 @@ class PagedKVCache:
         self._lengths[seq_id] = new_len
 
     def free(self, seq_id) -> int:
-        """Evict: return the sequence's pages to the pool. Returns the
-        page count released."""
+        """Evict: return the sequence's pages to its group's free
+        list. Returns the page count released."""
         table = self._tables.pop(seq_id)
         del self._lengths[seq_id]
-        self._free.extend(reversed(table))
+        group = self._groups[seq_id]
+        self._frees[group].extend(reversed(table))
         self._emit("free", seq_id)
+        del self._groups[seq_id]
         return len(table)
 
     def length(self, seq_id) -> int:
         return self._lengths[seq_id]
 
     def occupancy(self) -> dict:
-        return {"pages_used": self.pages_used,
-                "pages_total": self.cfg.usable_pages,
-                "seqs": self.seqs}
+        rec = {"pages_used": self.pages_used,
+               "pages_total": self.cfg.usable_pages_total,
+               "seqs": self.seqs}
+        if self.cfg.dp_groups > 1:
+            # Per-group occupancy rides the same record (additive —
+            # the metrics observer folds these into the labeled
+            # dtt_serving_* gauges; schema pinned by test).
+            rec["group_pages_used"] = [
+                self.pages_used_in(g)
+                for g in range(self.cfg.dp_groups)]
+            rec["group_seqs"] = [
+                self.seqs_in(g) for g in range(self.cfg.dp_groups)]
+        return rec
 
     # -- device-side views -------------------------------------------------
 
@@ -227,6 +315,17 @@ class PagedKVCache:
         for i, sid in enumerate(seq_ids):
             if sid is not None:
                 rows[i] = self.page_row(sid)
+        return rows
+
+    def page_rows_grouped(self, seq_ids_by_group: list) -> np.ndarray:
+        """(dp_groups, B_local, pages_per_seq) int32 tables from a
+        per-group nested id list — the decode program's layout (group
+        g's rows index ONLY group g's pool shard)."""
+        b = len(seq_ids_by_group[0]) if seq_ids_by_group else 0
+        rows = np.zeros((self.cfg.dp_groups, b,
+                         self.cfg.pages_per_seq), np.int32)
+        for g, ids in enumerate(seq_ids_by_group):
+            rows[g] = self.page_rows(ids)
         return rows
 
     def update_pools(self, k_pages, v_pages) -> None:

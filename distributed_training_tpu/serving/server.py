@@ -11,6 +11,13 @@ CI container):
   returns ``{"tokens", "text"?, "ttft_s", "latency_s"}``. Requests
   from many connections interleave in the engine's running batch —
   the HTTP handler threads only enqueue and wait.
+- ``POST /generate`` with ``"stream": true`` — chunked
+  transfer-encoding (HTTP/1.1): one JSON line per token, flushed the
+  moment the engine samples it (``{"token": N}``), then a final
+  ``{"done": true, "tokens", "ttft_s", "latency_s", ...}`` line.
+  Tokens ride the engine's per-token listeners
+  (``Engine.add_token_listener``) through a per-request queue — the
+  engine thread never blocks on a slow streaming client.
 - ``GET /healthz`` — 200 with queue/slot stats while the engine
   thread is alive.
 - live gauges — the engine's telemetry records flow through the
@@ -37,6 +44,7 @@ import argparse
 import http.server
 import json
 import logging
+import queue
 import threading
 import time
 
@@ -56,6 +64,7 @@ class ServingServer:
         self._mailbox: list = []
         self._done: dict[str, dict] = {}
         self._events: dict[str, threading.Event] = {}
+        self._streams: dict[str, queue.Queue] = {}
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._httpd = None
@@ -79,6 +88,15 @@ class ServingServer:
             with self._lock:
                 incoming, self._mailbox = self._mailbox, []
             for rid, prompt, n, arrival in incoming:
+                with self._lock:
+                    stream_q = self._streams.get(rid)
+                if stream_q is not None:
+                    # Registered BEFORE submit, on the engine thread:
+                    # the first token cannot race its listener.
+                    eng.add_token_listener(
+                        rid,
+                        lambda tok, done, _q=stream_q:
+                            _q.put(("token", tok)))
                 try:
                     eng.submit(Request(id=rid, prompt=prompt,
                                        max_new_tokens=n,
@@ -87,12 +105,17 @@ class ServingServer:
                     # An invalid request answers ITS caller; it must
                     # never take down the engine thread (and with it
                     # every other in-flight request).
+                    eng.remove_token_listener(rid)
                     with self._lock:
                         ev = self._events.pop(rid, None)
                         if ev is not None:
                             self._done[rid] = {"id": rid,
                                                "error": str(e)}
                             ev.set()
+                        sq = self._streams.pop(rid, None)
+                    if sq is not None:
+                        sq.put(("done", {"id": rid,
+                                         "error": str(e)}))
             if eng.idle:
                 time.sleep(0.002)
                 continue
@@ -104,6 +127,9 @@ class ServingServer:
                         if ev is not None:
                             self._done[rec["id"]] = rec
                             ev.set()
+                        sq = self._streams.pop(rec["id"], None)
+                        if sq is not None:
+                            sq.put(("done", rec))
                 eng.completed.clear()
 
     def generate(self, prompt: np.ndarray, max_new_tokens: int,
@@ -128,9 +154,65 @@ class ServingServer:
         with self._lock:
             return self._done.pop(rid)
 
+    def generate_stream(self, prompt: np.ndarray,
+                        max_new_tokens: int,
+                        timeout: float = 120.0):
+        """Enqueue + yield per-token dicts as the engine produces
+        them: ``{"token": N}`` per sampled token, then a final
+        ``{"done": True, "tokens", "ttft_s", "latency_s"}``. The
+        tokens flow engine thread → per-request queue → this
+        generator, so a slow consumer never stalls decode."""
+        arrival = time.monotonic()
+        q: queue.Queue = queue.Queue()
+        with self._lock:
+            rid = f"http-{self._next_id}"
+            self._next_id += 1
+            self._streams[rid] = q
+            self._mailbox.append((rid, np.asarray(prompt, np.int32),
+                                  int(max_new_tokens), arrival))
+        deadline = time.monotonic() + timeout
+        try:
+            while True:
+                try:
+                    kind, val = q.get(
+                        timeout=max(0.0,
+                                    deadline - time.monotonic()))
+                except queue.Empty:
+                    raise TimeoutError(
+                        f"request {rid} timed out mid-stream"
+                    ) from None
+                if kind == "token":
+                    yield {"token": int(val)}
+                    continue
+                if "error" in val:
+                    raise ValueError(val["error"])
+                out = {"done": True, "tokens": val["tokens"],
+                       "ttft_s": val["ttft_s"],
+                       "latency_s": val["latency_s"]}
+                if self.engine.model.cfg.vocab_size == 256:
+                    out["text"] = bytes(
+                        np.asarray(val["tokens"], np.uint8)).decode(
+                            "utf-8", errors="replace")
+                yield out
+                return
+        finally:
+            # Runs on completion, timeout, AND abandonment (the
+            # handler close()s the generator when the client
+            # disconnects mid-stream): without the deregistration
+            # the engine-side listener keeps filling an orphaned
+            # queue until the sequence drains. Idempotent — the
+            # engine loop pops both on normal completion too.
+            with self._lock:
+                self._streams.pop(rid, None)
+            self.engine.remove_token_listener(rid)
+
     # -- HTTP --------------------------------------------------------------
 
-    def _handle_generate(self, body: dict) -> dict:
+    def _parse_generate(self, body: dict):
+        """Validate a /generate body → (prompt_ids, max_new_tokens).
+        Raises ValueError (the 400 path) BEFORE anything reaches the
+        engine — the streaming handler needs every rejection to
+        happen while the status line is still writable."""
         vocab = self.engine.model.cfg.vocab_size
         if "prompt_ids" in body:
             ids = np.asarray([int(t) for t in body["prompt_ids"]],
@@ -155,12 +237,16 @@ class ServingServer:
             raise ValueError(
                 f"prompt ({ids.size}) + max_new_tokens ({n}) must "
                 f"fit max_seq_len ({limit})")
+        return ids, n
+
+    def _handle_generate(self, body: dict) -> dict:
+        ids, n = self._parse_generate(body)
         rec = self.generate(ids, n)
         if "error" in rec:
             raise ValueError(rec["error"])
         out = {"tokens": rec["tokens"], "ttft_s": rec["ttft_s"],
                "latency_s": rec["latency_s"]}
-        if vocab == 256:
+        if self.engine.model.cfg.vocab_size == 256:
             out["text"] = bytes(
                 np.asarray(rec["tokens"], np.uint8)).decode(
                     "utf-8", errors="replace")
@@ -170,13 +256,69 @@ class ServingServer:
         server = self
 
         class Handler(http.server.BaseHTTPRequestHandler):
+            # Chunked transfer-encoding (the streaming path) is an
+            # HTTP/1.1 construct; non-stream replies always carry
+            # Content-Length, so keep-alive semantics stay valid.
+            protocol_version = "HTTP/1.1"
+
             def _reply(self, code: int, payload: dict) -> None:
                 body = (json.dumps(payload) + "\n").encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
+                # One request per connection: clients here are
+                # one-shot, and a dangling keep-alive socket at
+                # server stop() surfaces as handler-thread noise.
+                self.send_header("Connection", "close")
+                self.close_connection = True
                 self.end_headers()
                 self.wfile.write(body)
+
+            def _chunk(self, data: bytes) -> None:
+                self.wfile.write(f"{len(data):X}\r\n".encode()
+                                 + data + b"\r\n")
+                self.wfile.flush()
+
+            def _stream_generate(self, body: dict) -> None:
+                try:
+                    ids, n = server._parse_generate(body)
+                except (ValueError, KeyError) as e:
+                    self._reply(400, {"error": str(e)})
+                    return
+                gen = server.generate_stream(ids, n)
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "application/jsonl")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.send_header("Connection", "close")
+                self.close_connection = True
+                self.end_headers()
+                try:
+                    for item in gen:
+                        self._chunk((json.dumps(item) + "\n")
+                                    .encode())
+                except (ValueError, TimeoutError) as e:
+                    # Headers are gone; the error becomes the
+                    # stream's last line (best-effort — the client
+                    # may already be gone).
+                    try:
+                        self._chunk((json.dumps(
+                            {"error": str(e)}) + "\n").encode())
+                    except OSError:
+                        pass
+                except OSError:
+                    # Client disconnected mid-stream; nobody left
+                    # to tell.
+                    pass
+                finally:
+                    # close() reaches generate_stream's finally so
+                    # the engine-side listener is deregistered even
+                    # when the stream is abandoned.
+                    gen.close()
+                    try:
+                        self.wfile.write(b"0\r\n\r\n")
+                    except OSError:
+                        pass
 
             def do_POST(self):  # noqa: N802 — http.server API
                 if self.path.split("?")[0] != "/generate":
@@ -185,6 +327,13 @@ class ServingServer:
                 try:
                     n = int(self.headers.get("Content-Length", 0))
                     body = json.loads(self.rfile.read(n) or b"{}")
+                except (ValueError, KeyError) as e:
+                    self._reply(400, {"error": str(e)})
+                    return
+                if body.get("stream"):
+                    self._stream_generate(body)
+                    return
+                try:
                     self._reply(200, server._handle_generate(body))
                 except (ValueError, KeyError) as e:
                     self._reply(400, {"error": str(e)})

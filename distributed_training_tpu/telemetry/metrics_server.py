@@ -67,6 +67,10 @@ class MetricsServer:
         self.stall_timeout_s = stall_timeout_s
         self._lock = threading.Lock()
         self._gauges: dict[str, float] = {}
+        # Labeled gauge families: name -> {label-pairs -> value}
+        # (the per-dp-group serving gauges; rendered as
+        # dtt_<name>{group="N"} rows, additive next to the flat set).
+        self._labeled: dict[str, dict[str, float]] = {}
         self._counters: dict[str, float] = {"steps_total": 0.0,
                                             "straggler_verdicts_total":
                                                 0.0,
@@ -180,6 +184,22 @@ class MetricsServer:
                 if isinstance(dur, (int, float)) and dur > 0 \
                         and isinstance(toks, (int, float)) and toks:
                     self._gauges["serving_tokens_per_s"] = toks / dur
+                # Per-dp-group shard gauges (the dp-sharded engine's
+                # step records carry per-group lists — serving/
+                # engine.py + kv_cache.occupancy; schema pinned by
+                # tests/test_serving.py).
+                for src, dst in (
+                        ("group_slots_active",
+                         "serving_group_slots_active"),
+                        ("group_pages_used",
+                         "serving_group_kv_pages_used"),
+                        ("group_seqs", "serving_group_seqs")):
+                    vals = rec.get(src)
+                    if isinstance(vals, (list, tuple)):
+                        fam = self._labeled.setdefault(dst, {})
+                        for g, v in enumerate(vals):
+                            if isinstance(v, (int, float)):
+                                fam[f'group="{g}"'] = float(v)
             elif kind == "serving_kv":
                 # Allocator records: keep occupancy live even between
                 # engine steps (join/evict happen inside steps, but
@@ -268,6 +288,11 @@ class MetricsServer:
         "serving_tokens_per_s": "Decode throughput of the last "
                                 "engine step",
         "serving_requests_total": "Requests completed by the engine",
+        "serving_group_slots_active": "Active decode slots per dp "
+                                      "group (dp-sharded engine)",
+        "serving_group_kv_pages_used": "KV pages allocated in each "
+                                       "dp group's pool shard",
+        "serving_group_seqs": "Sequences resident per dp group",
     }
 
     def render(self) -> str:
@@ -275,6 +300,7 @@ class MetricsServer:
         with self._lock:
             gauges = dict(self._gauges)
             counters = dict(self._counters)
+            labeled = {k: dict(v) for k, v in self._labeled.items()}
         gauges["up"] = 1.0
         lines: list[str] = []
         for name, value in sorted(gauges.items()):
@@ -282,6 +308,12 @@ class MetricsServer:
             lines.append(f"# HELP {full} {self._HELP.get(name, name)}")
             lines.append(f"# TYPE {full} gauge")
             lines.append(f"{full} {_fmt(value)}")
+        for name, fam in sorted(labeled.items()):
+            full = f"dtt_{name}"
+            lines.append(f"# HELP {full} {self._HELP.get(name, name)}")
+            lines.append(f"# TYPE {full} gauge")
+            for labels, value in sorted(fam.items()):
+                lines.append(f"{full}{{{labels}}} {_fmt(value)}")
         for name, value in sorted(counters.items()):
             full = f"dtt_{name}"
             lines.append(f"# HELP {full} {self._HELP.get(name, name)}")

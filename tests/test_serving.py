@@ -19,6 +19,7 @@ The correctness contracts the subsystem ships on:
 """
 
 import json
+import time
 
 import numpy as np
 import pytest
@@ -480,33 +481,72 @@ def test_export_cli_stamps_plan_provenance(tmp_path):
 # ---------------------------------------------------------------------------
 
 
-def test_disagg_pipeline_matches_colocated_engine(tiny_model,
-                                                  tmp_path):
-    """Two plans, one weight store, KV handed off between mesh
-    slices — greedy tokens identical to the co-located engine."""
+@pytest.fixture(scope="module")
+def serving_model():
     from distributed_training_tpu.models.transformer import (
         Transformer as TF, TransformerConfig as TC)
     from distributed_training_tpu.parallel.planner import (
-        SERVING_MODEL_KWARGS, load_plan)
-    from distributed_training_tpu.serving.disagg import (
-        DisaggPipeline, WeightStore, engine_config_for_plan)
+        SERVING_MODEL_KWARGS)
 
     model = TF(TC(**SERVING_MODEL_KWARGS))
-    params = model.init(jax.random.PRNGKey(1))
-    art = _artifact(tmp_path, params, {})
+    return model, model.init(jax.random.PRNGKey(1))
+
+
+@pytest.fixture(scope="module")
+def disagg_pipe(serving_model, tmp_path_factory):
+    from distributed_training_tpu.parallel.planner import load_plan
+    from distributed_training_tpu.serving.disagg import (
+        DisaggPipeline, WeightStore)
+
+    model, params = serving_model
+    tmp = tmp_path_factory.mktemp("disagg")
+    art = _artifact(tmp, params, {})
     store = WeightStore(art, check_provenance=False)
     pre = load_plan("serving_4dev_cpu_prefill")
     dec = load_plan("serving_4dev_cpu_decode")
     devs = jax.devices("cpu")
-    pipe = DisaggPipeline(store, pre, dec, devs[:4], devs[4:8])
+    return DisaggPipeline(store, pre, dec, devs[:4], devs[4:8]), dec
+
+
+def test_disagg_pipeline_matches_colocated_engine(serving_model,
+                                                  disagg_pipe):
+    """Two plans, one weight store, KV handed off between mesh
+    slices — greedy tokens identical to the co-located engine."""
+    from distributed_training_tpu.serving.disagg import (
+        engine_config_for_plan)
+
+    model, params = serving_model
+    pipe, dec = disagg_pipe
     prompt = np.asarray([9, 2, 77, 140, 33, 8, 250, 6], np.int32)
     got = pipe.generate(prompt, 10)
 
     colo = Engine(model, params, engine_config_for_plan(dec))
     assert got == colo.generate(prompt, 10)
     # The handoff crossed two different pool layouts (prefill slice
-    # unsharded kv, decode slice tp-sharded) — make that claim real.
+    # unsharded kv, decode slice dp×tp-sharded) — make that claim
+    # real.
     assert pipe.decode_engine.cache.sharding is not None
+    assert pipe.decode_engine.dp_groups == dec.mesh["dp"] > 1
+
+
+def test_batched_continuous_handoff_matches_per_request(disagg_pipe):
+    """The continuous-handoff rate path (generate_many: per-step
+    batched export/import overlapped with ongoing decode) is pinned
+    token-identical to the one-synchronous-transfer-per-request
+    path."""
+    from distributed_training_tpu.serving.engine import Request
+
+    pipe, _dec = disagg_pipe
+    rng = np.random.default_rng(21)
+    prompts = [rng.integers(0, 256, size=int(rng.integers(4, 20)))
+               .astype(np.int32) for _ in range(6)]
+    reqs = [Request(id=f"h{i}", prompt=p, max_new_tokens=6)
+            for i, p in enumerate(prompts)]
+    got = pipe.generate_many(reqs)
+    assert set(got) == {r.id for r in reqs}
+    for i, p in enumerate(prompts):
+        want = pipe.generate(p, 6, req_id=f"solo{i}")
+        assert got[f"h{i}"] == want, f"request h{i} diverged"
 
 
 # ---------------------------------------------------------------------------
@@ -554,6 +594,422 @@ def test_decode_plan_objective_and_kv_feasibility():
         target, Candidate(pp=1, dp=8, fsdp=1, sp=1, tp=1,
                           remat="none", batch_per_shard=32))
     assert rep["feasible"] is False and rep["reason"] == "hbm"
+
+
+# ---------------------------------------------------------------------------
+# dp-sharded decode (SERVING_r02): batch-parallel continuous batching
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def sharded_engine(serving_model):
+    """The committed decode plan's engine: slot table dealt over dp4,
+    pool sharded dp×tp, params placed per the plan."""
+    from distributed_training_tpu.parallel.planner import load_plan
+    from distributed_training_tpu.runtime import MeshSpec, build_mesh
+    from distributed_training_tpu.serving.disagg import (
+        engine_config_for_plan, place_params)
+
+    model, params = serving_model
+    plan = load_plan("serving_8dev_cpu_decode")
+    spec = MeshSpec(**{a: plan.mesh.get(a, 1)
+                       for a in ("pp", "dp", "fsdp", "sp", "tp")})
+    mesh = build_mesh(spec, jax.devices()[:spec.total])
+    eng = Engine(model, place_params(params, mesh, plan),
+                 engine_config_for_plan(plan), mesh=mesh)
+    eng.warmup()
+    return eng, plan
+
+
+def _drain_clean(eng):
+    eng.run_until_drained()
+    recs = {r["id"]: r for r in eng.completed}
+    eng.completed.clear()
+    assert eng.cache.pages_used == 0
+    return recs
+
+
+def test_dp_sharded_engine_matches_replicated(serving_model,
+                                              sharded_engine):
+    """THE tentpole pin: the dp-sharded engine (groups of
+    max_batch/dp slots, each against its own pool shard) produces
+    token-for-token what the replicated single-group engine produces
+    on the same request set — and join/evict stays zero-recompile."""
+    import dataclasses
+
+    model, params = serving_model
+    eng, plan = sharded_engine
+    counts = eng.compile_counts()
+    G = eng.dp_groups
+    assert G == plan.mesh["dp"] > 1
+    assert eng.batch_local * G == eng.cfg.max_batch
+
+    rng = np.random.default_rng(17)
+    prompts = [rng.integers(0, 256, size=int(rng.integers(3, 24)))
+               .astype(np.int32) for _ in range(12)]
+    for i, p in enumerate(prompts):
+        eng.submit(Request(id=f"r{i}", prompt=p, max_new_tokens=8))
+    sharded = _drain_clean(eng)
+    assert eng.compile_counts() == counts, \
+        "dp-sharded join/evict changed a traced shape"
+    # Work actually spread over groups (12 requests, 4 groups).
+    assert len({r["group"] for r in sharded.values()}) == G
+
+    # The PR-13-shaped reference: one group holding the WHOLE slot
+    # table (same aggregate pool budget), unsharded.
+    ref = Engine(model, params, dataclasses.replace(
+        eng.cfg, num_pages=G * (eng.cfg.num_pages - 1) + 1))
+    for i, p in enumerate(prompts):
+        ref.submit(Request(id=f"r{i}", prompt=p, max_new_tokens=8))
+    want = _drain_clean(ref)
+    assert {k: v["tokens"] for k, v in sharded.items()} == \
+        {k: v["tokens"] for k, v in want.items()}
+
+
+def test_batch_composition_independence_across_groups(
+        serving_model, sharded_engine):
+    """A sequence decodes the same tokens whichever GROUP it lands
+    in and whoever shares the batch — greedy decode must be exact
+    across the shard boundary."""
+    model, params = serving_model
+    eng, _plan = sharded_engine
+    rng = np.random.default_rng(19)
+    prompts = [rng.integers(0, 256, size=int(rng.integers(4, 16)))
+               .astype(np.int32) for _ in range(9)]
+    for i, p in enumerate(prompts):
+        eng.submit(Request(id=f"b{i}", prompt=p, max_new_tokens=6))
+    batched = _drain_clean(eng)
+    # Solo on the SAME sharded engine: lands in group 0 (empty
+    # engine, fewest-active tie to the lowest index) — group
+    # assignment differs from the batched run for most requests.
+    for i in (2, 5, 8):
+        eng.submit(Request(id=f"solo{i}", prompt=prompts[i],
+                           max_new_tokens=6))
+        solo = _drain_clean(eng)
+        assert solo[f"solo{i}"]["tokens"] == \
+            batched[f"b{i}"]["tokens"]
+
+
+def test_per_shard_allocator_leak_freedom_random_join_evict():
+    """The PR-13 leak invariant, per dp group: any join/evict order
+    keeps every group's ``used + free == usable`` exact, allocations
+    never bleed across shards, and a full drain returns every group
+    to zero."""
+    from distributed_training_tpu.serving.kv_cache import (
+        PagedCacheConfig, PagedKVCache)
+
+    G = 4
+    cfg = PagedCacheConfig(n_layers=2, n_kv_heads=2, head_dim=16,
+                           page_size=8, num_pages=16, max_seq_len=64,
+                           dp_groups=G)
+    cache = PagedKVCache(cfg)
+    rng = np.random.default_rng(23)
+    live: dict[int, tuple[int, int]] = {}   # sid -> (group, tokens)
+    next_id = 0
+    for _ in range(600):
+        per_group = [0] * G
+        for sid, (g, n) in live.items():
+            per_group[g] += -(-n // cfg.page_size) if n else 0
+        for g in range(G):
+            assert cache.pages_used_in(g) == per_group[g]
+            assert cache.pages_used_in(g) + \
+                cache.free_pages_in(g) == cfg.usable_pages
+        assert cache.pages_used == sum(per_group)
+        op = rng.integers(0, 3)
+        if op == 0 and len(live) < 12:
+            g = int(rng.integers(0, G))
+            cache.join(next_id, group=g)
+            assert cache.group_of(next_id) == g
+            live[next_id] = (g, 0)
+            next_id += 1
+        elif op == 1 and live:
+            sid = int(rng.choice(list(live)))
+            g, n = live[sid]
+            want = min(n + int(rng.integers(1, 20)),
+                       cfg.max_seq_len)
+            if cache.ensure(sid, want):
+                cache.advance(sid, want - n)
+                live[sid] = (g, want)
+        elif op == 2 and live:
+            sid = int(rng.choice(list(live)))
+            cache.free(sid)
+            del live[sid]
+    for sid in list(live):
+        cache.free(sid)
+    assert cache.pages_used == 0
+    for g in range(G):
+        assert cache.free_pages_in(g) == cfg.usable_pages
+
+
+def test_admission_balances_skewed_arrival_burst(serving_model,
+                                                 sharded_engine):
+    """A burst arriving all at once must spread over the dp groups
+    (fewest-active-slots-first) instead of piling onto shard 0 while
+    the others idle."""
+    eng, _plan = sharded_engine
+    G, B = eng.dp_groups, eng.batch_local
+    rng = np.random.default_rng(29)
+    n_burst = G * 2
+    for i in range(n_burst):
+        eng.submit(Request(
+            id=f"burst{i}",
+            prompt=rng.integers(0, 256, size=6).astype(np.int32),
+            max_new_tokens=4))
+    # One admission per step: step until the whole burst is in.
+    for _ in range(n_burst * 3):
+        if eng.in_flight == n_burst:
+            break
+        eng.step()
+    assert eng.in_flight == n_burst
+    assert eng.slots_active_by_group() == [n_burst // G] * G, \
+        "burst piled onto a subset of dp groups"
+    recs = _drain_clean(eng)
+    groups = [r["group"] for r in recs.values()]
+    assert sorted(set(groups)) == list(range(G))
+
+
+def test_sharded_engine_emits_group_gauges(serving_model,
+                                           tmp_path):
+    """The per-dp-group serving gauges: step records carry per-group
+    slot/page lists and /metrics exports them as labeled rows,
+    additive next to the flat serving schema."""
+    import urllib.request
+
+    from distributed_training_tpu.parallel.planner import load_plan
+    from distributed_training_tpu.runtime import MeshSpec, build_mesh
+    from distributed_training_tpu.serving.disagg import (
+        engine_config_for_plan, place_params)
+    from distributed_training_tpu.telemetry import (
+        MetricsServer, Telemetry, install, uninstall)
+
+    model, params = serving_model
+    plan = load_plan("serving_8dev_cpu_decode")
+    spec = MeshSpec(**{a: plan.mesh.get(a, 1)
+                       for a in ("pp", "dp", "fsdp", "sp", "tp")})
+    mesh = build_mesh(spec, jax.devices()[:spec.total])
+    tel = Telemetry(events_jsonl=str(tmp_path / "events.jsonl"))
+    install(tel)
+    try:
+        ms = MetricsServer(0, telemetry=tel)
+        assert ms.start() is not None
+        eng = Engine(model, place_params(params, mesh, plan),
+                     engine_config_for_plan(plan), mesh=mesh)
+        eng.submit(Request(id="g0",
+                           prompt=np.asarray([1, 2, 3], np.int32),
+                           max_new_tokens=4))
+        eng.run_until_drained()
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{ms.port}/metrics",
+            timeout=10).read().decode()
+        for g in range(eng.dp_groups):
+            assert (f'dtt_serving_group_slots_active{{group="{g}"}}'
+                    in body)
+            assert (f'dtt_serving_group_kv_pages_used{{group="{g}"}}'
+                    in body)
+        # Flat schema intact next to the labeled rows.
+        for gauge in SERVING_GAUGES:
+            assert f"\n{gauge} " in "\n" + body
+        ms.stop()
+    finally:
+        uninstall()
+        tel.close()
+
+
+def test_http_streaming_tokens_match_nonstream(tiny_model):
+    """`"stream": true` returns chunked transfer-encoding, one JSON
+    line per token, and the streamed tokens equal the blocking
+    path's token-for-token."""
+    import http.client
+
+    from distributed_training_tpu.serving.server import ServingServer
+
+    model, params = tiny_model
+    srv = ServingServer(_engine(model, params), port=0)
+    assert srv.start() is not None
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", srv.port,
+                                          timeout=60)
+        conn.request(
+            "POST", "/generate",
+            json.dumps({"prompt_ids": [5, 7, 11],
+                        "max_new_tokens": 6,
+                        "stream": True}).encode(),
+            {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        assert resp.status == 200
+        assert resp.getheader("Transfer-Encoding") == "chunked"
+        lines = []
+        while True:
+            line = resp.readline()
+            if not line:
+                break
+            lines.append(json.loads(line))
+        toks = [ln["token"] for ln in lines if "token" in ln]
+        final = lines[-1]
+        assert final["done"] is True
+        assert final["tokens"] == toks
+        assert len(toks) == 6
+        conn2 = http.client.HTTPConnection("127.0.0.1", srv.port,
+                                           timeout=60)
+        conn2.request(
+            "POST", "/generate",
+            json.dumps({"prompt_ids": [5, 7, 11],
+                        "max_new_tokens": 6}).encode(),
+            {"Content-Type": "application/json"})
+        blocking = json.loads(conn2.getresponse().read())
+        assert blocking["tokens"] == toks
+        # A bad streamed request still 400s BEFORE the stream opens.
+        conn3 = http.client.HTTPConnection("127.0.0.1", srv.port,
+                                           timeout=60)
+        conn3.request(
+            "POST", "/generate",
+            json.dumps({"prompt_ids": [], "stream": True}).encode(),
+            {"Content-Type": "application/json"})
+        assert conn3.getresponse().status == 400
+    finally:
+        srv.stop()
+
+
+def test_stream_abandonment_deregisters_listener(tiny_model):
+    """Closing a streaming generator mid-request (the client-went-
+    away path) must deregister the engine-side token listener and
+    the stream queue immediately — not leave them filling an
+    orphaned queue until the sequence drains."""
+    from distributed_training_tpu.serving.server import ServingServer
+
+    model, params = tiny_model
+    srv = ServingServer(_engine(model, params), port=0)
+    assert srv.start() is not None
+    try:
+        gen = srv.generate_stream(
+            np.asarray([5, 7, 11], np.int32), 12)
+        first = next(gen)
+        assert "token" in first
+        gen.close()  # client disconnect
+        assert srv._streams == {}
+        assert srv.engine._token_listeners == {}
+        # The abandoned request still completes in the engine, and
+        # the server keeps serving.
+        deadline = time.monotonic() + 30
+        while (srv.engine.in_flight or srv._mailbox) \
+                and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert srv.engine.in_flight == 0
+        rec = srv.generate(np.asarray([5, 7, 11], np.int32), 4)
+        assert len(rec["tokens"]) == 4
+    finally:
+        srv.stop()
+
+
+def test_http_stream_client_disconnect_keeps_serving(tiny_model):
+    """A client that drops the connection mid-stream must not take
+    down the handler (BrokenPipeError on the chunk/terminator
+    writes) — the next request is served normally."""
+    import http.client
+
+    from distributed_training_tpu.serving.server import ServingServer
+
+    model, params = tiny_model
+    srv = ServingServer(_engine(model, params), port=0)
+    assert srv.start() is not None
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", srv.port,
+                                          timeout=60)
+        conn.request(
+            "POST", "/generate",
+            json.dumps({"prompt_ids": [5, 7, 11],
+                        "max_new_tokens": 16,
+                        "stream": True}).encode(),
+            {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        assert json.loads(resp.readline()).get("token") is not None
+        conn.close()  # walk away mid-stream
+        conn2 = http.client.HTTPConnection("127.0.0.1", srv.port,
+                                           timeout=60)
+        conn2.request(
+            "POST", "/generate",
+            json.dumps({"prompt_ids": [5, 7, 11],
+                        "max_new_tokens": 6}).encode(),
+            {"Content-Type": "application/json"})
+        blocking = json.loads(conn2.getresponse().read())
+        assert len(blocking["tokens"]) == 6
+        # The abandoned stream request may still be decoding
+        # (continuous batching ran both concurrently); once it
+        # drains, nothing may be left registered.
+        deadline = time.monotonic() + 30
+        while srv.engine.in_flight and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert srv.engine.in_flight == 0
+        assert srv.engine._token_listeners == {}
+        assert srv._streams == {}
+    finally:
+        srv.stop()
+
+
+def test_preempt_drops_token_listeners(tiny_model):
+    """preempt() hands unfinished work back fresh — a listener left
+    registered would stream a resubmitted request's early tokens
+    twice."""
+    model, params = tiny_model
+    eng = _engine(model, params, num_pages=96)
+    seen: list[int] = []
+    eng.submit(Request(id="s0",
+                       prompt=np.asarray([1, 2, 3, 4], np.int32),
+                       max_new_tokens=8))
+    eng.add_token_listener("s0", lambda tok, done: seen.append(tok))
+    for _ in range(4):
+        eng.step()
+    n_before = len(seen)
+    assert n_before > 0
+    lost = eng.preempt()
+    assert eng._token_listeners == {}
+    for r in lost:
+        eng.submit(r)
+    eng.run_until_drained()
+    # The re-run emitted nothing to the stale listener.
+    assert len(seen) == n_before
+    (rec,) = eng.completed
+    assert len(rec["tokens"]) == 8
+
+
+def test_serving_r02_ledger_committed_and_coherent():
+    """SERVING_r02.json: the dp-sharded acceptance gates stay
+    machine-checked — >= 2x r01's aggregate tokens/s on the same
+    storm, zero recompiles, an embedded compared_to block, streamed
+    TTFT, and the greedy-vs-full-context parity flag."""
+    import os
+
+    root = os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))
+    with open(os.path.join(root, "SERVING_r02.json")) as f:
+        doc = json.load(f)
+    with open(os.path.join(root, "SERVING_r01.json")) as f:
+        r01 = json.load(f)
+    steady = doc["steady"]
+    assert steady["recompiles_after_warmup"] == 0
+    # Concurrency must span dp groups (a faster engine legitimately
+    # holds FEWER requests in flight on the same realtime storm, so
+    # the r01-era absolute >= 20 gate would punish speed).
+    assert steady["max_in_flight"] > steady["slots_per_group"]
+    assert steady["dp_groups"] > 1
+    cmp_block = doc["compared_to"]
+    assert cmp_block["revision"] == "r01"
+    assert cmp_block["tokens_per_s"] == \
+        r01["steady"]["tokens_per_s"]
+    # THE acceptance number: saturated aggregate decode throughput
+    # (the realtime storm is arrival-bound — its ~0.8s Poisson span
+    # caps any engine near 1.4k tok/s; the note works the math).
+    assert doc["saturated"]["tokens_per_s"] >= \
+        2 * cmp_block["tokens_per_s"]
+    assert cmp_block["speedup"] >= 2
+    assert doc["saturated"]["replicated_same_mesh"][
+        "tokens_per_s"] > 0
+    assert doc["plan"]["mesh"]["dp"] > 1
+    assert doc["steady"]["greedy_matches_full_context"] is True
+    assert doc["streaming"]["ttft_first_byte_s"] > 0
+    pre = doc["preemption"]
+    assert pre["tokens_match_steady_storm"] is True
+    assert 0 < pre["goodput"] <= 1
 
 
 def test_serving_ledger_committed_and_coherent():
