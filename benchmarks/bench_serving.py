@@ -2,38 +2,46 @@
 
 The measured half of ROADMAP item 1 ("millions of users, heavy
 traffic" as a number, not a slogan). The SAME seeded workload as
-SERVING_r01, now against the dp-SHARDED engine (serving/engine.py:
-the decode slot table dealt over the plan's dp groups, each decoding
-only its own slots against its own pool shard), on the 8-device CPU
-mesh under the committed decode plan
+SERVING_r01/r02, now against the r03 engine (serving/engine.py:
+BATCHED multi-sequence prefill — up to ``prefill_slots`` prompts'
+chunks per launch, dealt over dp like the decode table — plus
+MULTI-TOKEN SELF-SPECULATIVE decode: ``spec_k`` tokens per launch,
+drafted by prompt lookup and verified as one argmax chain), on the
+8-device CPU mesh under the committed decode plan
 (``conf/plans/serving_8dev_cpu_decode.json``), served train→export→
 serve style from a consolidated artifact through the WeightStore:
 
 - **steady storm** — Poisson arrivals into the continuous-batching
-  engine; records AGGREGATE tokens/s with an in-entry
-  ``compared_to`` block against the r01 (replicated-table) ledger,
-  p50/p99 TTFT, p50/p99 per-token latency, peak concurrency (the
-  ledger gate wants ≥ 20), ASSERTS zero recompiles after warmup (jit
+  engine; p50/p99 TTFT, p50/p99 per-token latency, peak concurrency,
+  ASSERTS zero recompiles after warmup for BOTH new programs (jit
   cache sizes before/after the storm), and re-proves a sample of the
   greedy streams token-identical to the full-context
-  ``model.apply``-per-token reference.
+  ``model.apply``-per-token reference — the parity pin covering
+  batched prefill and speculative decode at once.
+- **prefill microbench** — the storm's prompts as a pure-prefill
+  backlog (one new token each) through the batched engine AND an
+  r02-style one-sequence-per-launch engine on the same mesh in the
+  same run: aggregate prompt tokens/s, launch counts, the ≥2×
+  acceptance gate, and first-token parity between the two.
+- **speculative decode** — the same seeded workload as a saturated
+  backlog through the spec engine AND a spec_k=1 (r02-style
+  one-token-per-launch) engine same-run: aggregate decode tokens/s,
+  the mean ACCEPTED chain length recorded honestly, the
+  improves-over-per-token gate, and identical token streams.
 - **streamed TTFT** — one request through the HTTP server's
   ``"stream": true`` chunked path on the warmed engine; TTFT is
-  measured at the FIRST BYTE of the first token line, the number a
-  client actually sees.
+  measured at the FIRST BYTE of the first token line.
 - **preemption storm** — the same workload driven under
   ``resilience/supervisor.supervise``: mid-storm the engine
-  incarnation preempts (rc 143 — the supervisor's clean-preemption
-  classification), losing all in-flight decode state; the next
-  incarnation resubmits the unfinished requests and drains the
-  queue. Records goodput (useful tokens ÷ generated tokens — redone
-  prefill/decode work is the preemption tax) and asserts the final
-  token streams are IDENTICAL to the steady storm's (greedy decode
-  is preemption-transparent).
+  incarnation preempts (rc 143), losing all in-flight decode state;
+  the next incarnation resubmits and drains. Records goodput and
+  asserts the final token streams are IDENTICAL to the steady
+  storm's (speculation and batched prefill are
+  preemption-transparent too).
 
-Writes ``SERVING_r02.json`` at the repo root::
+Writes ``SERVING_r03.json`` at the repo root::
 
-    python benchmarks/bench_serving.py --out SERVING_r02.json
+    python benchmarks/bench_serving.py --out SERVING_r03.json
 """
 
 from __future__ import annotations
@@ -80,7 +88,8 @@ def build_workload(n_requests: int, rate_per_s: float, seed: int,
     return out
 
 
-def make_engine(store, plan, mesh, prefill_chunk: int = 32):
+def make_engine(store, plan, mesh, prefill_chunk: int = 32,
+                spec_k: int = 1, prefill_mode: str = "batched"):
     from distributed_training_tpu.parallel.planner import (
         model_for_plan)
     from distributed_training_tpu.serving.disagg import (
@@ -88,13 +97,15 @@ def make_engine(store, plan, mesh, prefill_chunk: int = 32):
     from distributed_training_tpu.serving.engine import Engine
 
     # prefill_chunk 32 (vs r01's 16): every U[4,24]-token prompt
-    # prefills in ONE launch — on the dispatch-bound CPU mesh the
-    # launch count, not the chunk compute, is the prefill cost.
-    # Recorded in the ledger's engine block.
+    # prefills in ONE chunk; since r03 the batched lane table packs
+    # up to max_batch such chunks into ONE LAUNCH. spec_k > 1 turns
+    # on the multi-token speculative decode program.
     return Engine(model_for_plan(plan),
                   store.params_for(mesh, plan),
                   engine_config_for_plan(plan,
-                                         prefill_chunk=prefill_chunk),
+                                         prefill_chunk=prefill_chunk,
+                                         prefill_mode=prefill_mode,
+                                         spec_k=spec_k),
                   mesh=mesh)
 
 
@@ -248,14 +259,18 @@ def main(argv=None) -> int:
     ap.add_argument("--prefill-chunk", type=int, default=32,
                     help="engine prefill chunk (r01 ran 16; 32 "
                          "prefills every U[4,24] prompt in one "
-                         "launch)")
+                         "chunk, and the r03 lane table packs up to "
+                         "max_batch chunks per launch)")
+    ap.add_argument("--spec-k", type=int, default=4,
+                    help="speculative decode tokens per launch "
+                         "(1 = the r02 one-token decode)")
     ap.add_argument("--preempt-after", type=int, default=12,
                     help="preempt the engine after this many "
                          "completions (mid-storm)")
     ap.add_argument("--out", default=_os.path.join(
-        REPO, "SERVING_r02.json"))
+        REPO, "SERVING_r03.json"))
     ap.add_argument("--compare", default=_os.path.join(
-        REPO, "SERVING_r01.json"),
+        REPO, "SERVING_r02.json"),
         help="previous ledger entry for the in-entry compared_to "
              "block ('' disables)")
     ap.add_argument("--parity-sample", type=int, default=6,
@@ -296,7 +311,10 @@ def main(argv=None) -> int:
                               args.max_new_tokens)
 
     # -- storm 1: steady state, zero-recompile assertion ---------------
-    engine = make_engine(store, plan, mesh, args.prefill_chunk)
+    # The full r03 engine: batched multi-sequence prefill + spec_k
+    # speculative decode.
+    engine = make_engine(store, plan, mesh, args.prefill_chunk,
+                         spec_k=args.spec_k)
     warm_counts = engine.warmup()
     stats = drive_storm(engine, workload)
     post_counts = engine.compile_counts()
@@ -305,12 +323,18 @@ def main(argv=None) -> int:
             f"engine recompiled mid-storm: warmup {warm_counts} -> "
             f"{post_counts}")
     steady = summarize(stats["completed"], stats["wall_s"])
+    spec = engine.spec_stats
     steady.update(max_in_flight=stats["max_in_flight"],
                   steps=stats["steps"],
                   compile_counts=warm_counts,
                   recompiles_after_warmup=0,
                   dp_groups=engine.dp_groups,
-                  slots_per_group=engine.batch_local)
+                  slots_per_group=engine.batch_local,
+                  prefill_lanes_per_group=engine.prefill_local,
+                  spec_k=args.spec_k,
+                  spec_accepted_mean=round(
+                      spec["emitted"] / spec["launches"], 3)
+                  if spec["launches"] else None)
     tokens_by_id = {r["id"]: r["tokens"] for r in stats["completed"]}
 
     # Greedy parity vs the full-context reference: the dp-sharded
@@ -343,24 +367,74 @@ def main(argv=None) -> int:
     if engine.compile_counts() != warm_counts:
         raise AssertionError("streaming recompiled the engine")
 
-    # -- saturated aggregate throughput --------------------------------
+    # -- prefill microbench: batched vs one-seq-per-launch, same run ---
+    # The storm's 48 prompts as a PURE-PREFILL backlog (one new token
+    # each, so a request completes the moment its prompt does): the
+    # batched engine packs up to max_batch lanes' chunks per launch,
+    # the r02-style engine replays one replicated chunk per launch
+    # with the dead groups masked. Same mesh, same store, same run —
+    # aggregate prompt tokens/s is the number, ≥2× is the gate.
+    from distributed_training_tpu.serving.engine import Request
+
+    def prefill_run(eng):
+        warm = eng.warmup()
+        for (_t, prompt, _n, rid) in workload:
+            eng.submit(Request(id=rid, prompt=prompt,
+                               max_new_tokens=1))
+        t0 = time.monotonic()
+        steps = eng.run_until_drained()
+        wall = time.monotonic() - t0
+        if eng.compile_counts() != warm:
+            raise AssertionError("recompiled during prefill drain")
+        ptoks = sum(r["prompt_tokens"] for r in eng.completed)
+        firsts = {r["id"]: r["tokens"][0] for r in eng.completed}
+        return {"prompt_tokens": ptoks, "wall_s": round(wall, 3),
+                "steps": steps,
+                "prefill_tokens_per_s": round(ptoks / wall, 2)}, \
+            firsts
+
+    batched_pf, firsts_b = prefill_run(
+        make_engine(store, plan, mesh, args.prefill_chunk))
+    sequential_pf, firsts_s = prefill_run(
+        make_engine(store, plan, mesh, args.prefill_chunk,
+                    prefill_mode="sequential"))
+    if firsts_b != firsts_s:
+        raise AssertionError(
+            "batched prefill first tokens diverged from the "
+            "sequential path")
+    if any(firsts_b[rid] != tokens_by_id[rid][0]
+           for rid in firsts_b):
+        raise AssertionError(
+            "prefill microbench first tokens diverged from the "
+            "steady storm")
+    prefill = {
+        "batched": batched_pf,
+        "sequential_same_mesh": sequential_pf,
+        "speedup_vs_sequential_same_run": round(
+            batched_pf["prefill_tokens_per_s"]
+            / sequential_pf["prefill_tokens_per_s"], 3),
+        "lanes": engine.cfg.prefill_slots or engine.cfg.max_batch,
+        "prefill_chunk": args.prefill_chunk,
+        "first_tokens_match_sequential": True,
+    }
+    if prefill["speedup_vs_sequential_same_run"] < 2.0:
+        raise AssertionError(
+            f"batched prefill {batched_pf['prefill_tokens_per_s']} "
+            f"tok/s is below 2x the one-seq-per-launch path "
+            f"{sequential_pf['prefill_tokens_per_s']} — the "
+            "launch-amortization claim does not hold on this run")
+
+    # -- saturated decode: speculative vs per-token launches, same run -
     # The realtime storm above is ARRIVAL-bound: its 48 Poisson
     # arrivals at 60/s span ~0.8s, so no engine — however fast — can
     # exceed ~1.4k tok/s on it (total tokens / arrival span is a
-    # hard ceiling). Aggregate decode THROUGHPUT, the number the
-    # dp-sharded slot table scales, is measured with the SAME seeded
-    # workload submitted as a backlog (arrival offsets collapsed):
-    # the engine is the only bottleneck. An r01-style
-    # replicated-table engine on the SAME mesh drains the same
-    # backlog in-process for the engine-vs-engine comparison, and
-    # both engines' token streams must match the realtime storm's.
-    import dataclasses as _dc
-
-    from distributed_training_tpu.serving.disagg import (
-        engine_config_for_plan)
-    from distributed_training_tpu.serving.engine import (Engine,
-                                                         Request)
-
+    # hard ceiling). Aggregate throughput is measured on the SAME
+    # seeded workload submitted as a backlog (arrival offsets
+    # collapsed): the engine is the only bottleneck. The spec_k=1
+    # engine IS r02's one-token-per-launch decode (same batched
+    # prefill, so the comparison isolates the speculative claim),
+    # and both engines' token streams must match the realtime
+    # storm's — speculation changes launch counts, never tokens.
     def saturated_run(eng):
         warm = eng.warmup()
         for (_t, prompt, n, rid) in workload:
@@ -376,24 +450,34 @@ def main(argv=None) -> int:
         if streams != tokens_by_id:
             raise AssertionError(
                 "saturated drain changed token streams")
-        return {"new_tokens": toks, "wall_s": round(wall, 3),
-                "steps": steps,
-                "tokens_per_s": round(toks / wall, 2)}
+        rec = {"new_tokens": toks, "wall_s": round(wall, 3),
+               "steps": steps,
+               "tokens_per_s": round(toks / wall, 2)}
+        if eng.spec_stats["launches"]:
+            rec["spec_accepted_mean"] = round(
+                eng.spec_stats["emitted"]
+                / eng.spec_stats["launches"], 3)
+            rec["spec_launches"] = eng.spec_stats["launches"]
+        return rec
 
-    ecfg = engine_config_for_plan(plan,
-                                  prefill_chunk=args.prefill_chunk)
     saturated = saturated_run(
-        make_engine(store, plan, mesh, args.prefill_chunk))
-    rep_cfg = _dc.replace(
-        ecfg,
-        num_pages=plan.mesh.get("dp", 1) * (ecfg.num_pages - 1) + 1,
-        dp_axis="none")   # no such mesh axis -> one group, r01-style
-    replicated = saturated_run(Engine(
-        model_for_plan(plan), store.params_for(mesh, plan), rep_cfg,
-        mesh=mesh))
-    saturated["replicated_same_mesh"] = replicated
-    saturated["speedup_vs_replicated_same_run"] = round(
-        saturated["tokens_per_s"] / replicated["tokens_per_s"], 3)
+        make_engine(store, plan, mesh, args.prefill_chunk,
+                    spec_k=args.spec_k))
+    per_token = saturated_run(
+        make_engine(store, plan, mesh, args.prefill_chunk,
+                    spec_k=1))
+    saturated["spec_k"] = args.spec_k
+    saturated["per_token_same_mesh"] = per_token
+    saturated["speedup_vs_per_token_same_run"] = round(
+        saturated["tokens_per_s"] / per_token["tokens_per_s"], 3)
+    if args.spec_k > 1 \
+            and saturated["speedup_vs_per_token_same_run"] <= 1.0:
+        raise AssertionError(
+            f"speculative decode {saturated['tokens_per_s']} tok/s "
+            f"does not improve on per-token launches "
+            f"{per_token['tokens_per_s']} — the amortization claim "
+            "does not hold on this run (accepted mean "
+            f"{saturated.get('spec_accepted_mean')})")
 
     # -- storm 2: supervised mid-storm preemption ----------------------
     state = {"workload": workload, "incarnations": [],
@@ -402,7 +486,8 @@ def main(argv=None) -> int:
     def run_incarnation(env) -> int:
         inc = len(state["incarnations"])
         _os.environ.update(env)
-        eng = make_engine(store, plan, mesh, args.prefill_chunk)
+        eng = make_engine(store, plan, mesh, args.prefill_chunk,
+                          spec_k=args.spec_k)
         warm = eng.warmup()
         wl = state["workload"]
         preempt_at = args.preempt_after if inc == 0 else None
@@ -461,40 +546,36 @@ def main(argv=None) -> int:
     if args.compare and _os.path.exists(args.compare):
         with open(args.compare, encoding="utf-8") as f:
             prev = json.load(f)
-        prev_tps = prev["steady"]["tokens_per_s"]
+        # r02's acceptance number was its SATURATED aggregate drain
+        # (the realtime storm is arrival-bound either way).
+        prev_sat = (prev.get("saturated") or {}).get("tokens_per_s") \
+            or prev["steady"]["tokens_per_s"]
+        prev_steady = prev["steady"]["tokens_per_s"]
         compared_to = {
             "revision": prev.get("revision"),
             "entry": _os.path.basename(args.compare),
-            "tokens_per_s": prev_tps,
+            "tokens_per_s": prev_sat,
+            "steady_tokens_per_s": prev_steady,
             "ttft_s": prev["steady"]["ttft_s"],
             "per_token_latency_s":
                 prev["steady"]["per_token_latency_s"],
-            "engine": "replicated slot table (every dp replica "
-                      "decoded all slots)",
-            # The acceptance number: saturated aggregate throughput
-            # vs the committed r01 figure (whose storm ran its
-            # engine near-saturated: wall 1.01s vs ~0.8s arrivals).
+            "engine": "dp-sharded one-token-per-launch decode + "
+                      "one-seq-per-launch replicated prefill (r02)",
+            # Cross-run context (shared-container wall clocks are
+            # noisy; the GATED claims are the same-run comparisons
+            # in the prefill and saturated blocks above).
             "speedup": round(
-                saturated["tokens_per_s"] / prev_tps, 3)
-            if prev_tps else None,
-            # Same realtime storm vs realtime storm, for context —
-            # bounded by the shared arrival span, not the engine.
+                saturated["tokens_per_s"] / prev_sat, 3)
+            if prev_sat else None,
             "realtime_speedup": round(
-                steady["tokens_per_s"] / prev_tps, 3)
-            if prev_tps else None,
+                steady["tokens_per_s"] / prev_steady, 3)
+            if prev_steady else None,
         }
-        if compared_to["speedup"] is not None \
-                and compared_to["speedup"] < 2.0:
-            raise AssertionError(
-                f"dp-sharded aggregate tokens/s "
-                f"{saturated['tokens_per_s']} is below 2x the r01 "
-                f"baseline {prev_tps} — the batch-parallel claim "
-                "does not hold on this run")
 
     doc = {
         "schema": SCHEMA,
         "bench": "serving",
-        "revision": "r02",
+        "revision": "r03",
         "recorded_unix": int(time.time()),
         "plan": {"name": plan.name,
                  "fingerprint": plan.fingerprint(),
@@ -515,36 +596,44 @@ def main(argv=None) -> int:
             "seed": args.seed,
             "scheduling_policy": "prefill",
             "prefill_chunk": args.prefill_chunk,
+            "spec_k": args.spec_k,
         },
         "steady": steady,
+        "prefill": prefill,
         "saturated": saturated,
         "streaming": streaming,
         "preemption": preemption,
         "compared_to": compared_to,
         "note": "Tiny serving model (SERVING_MODEL_KWARGS) on the "
                 "fake CPU mesh — an honest CPU-scale measurement of "
-                "the dp-sharded continuous-batching machinery "
-                "(compile stability, concurrency, streamed "
-                "first-byte TTFT, preemption goodput), not a TPU "
+                "the launch-amortizing serving machinery, not a TPU "
                 "throughput claim. Honesty notes: (1) the realtime "
                 "steady storm is arrival-bound (48 Poisson arrivals "
-                "at 60/s span ~0.8s — total tokens / arrival span "
-                "caps ANY engine near 1.4k tok/s), so the "
-                "acceptance speedup is measured on the saturated "
-                "backlog drain of the same seeded workload; (2) on "
+                "at 60/s span ~0.8s), so both r03 claims are gated "
+                "on SAME-RUN saturated comparisons: the prefill "
+                "block drains the storm's prompts as a pure-prefill "
+                "backlog through the batched lane table vs the "
+                "r02-style one-seq-per-launch path, and the "
+                "saturated block drains the full workload with "
+                "spec_k-token launches vs one-token launches; (2) "
+                "the speculative acceptance length is HIGH on this "
+                "workload because the tiny random-init model's "
+                "greedy outputs are strongly repetitive — exactly "
+                "the regime prompt-lookup drafting exploits; on a "
+                "trained model the acceptance (and therefore the "
+                "speedup) depends on output self-similarity, and "
+                "k>1 LOSES when acceptance stays near 1 (every "
+                "launch then pays k positions' compute for one "
+                "token) — docs/serving.md works the trade; (3) on "
                 "these 8 fake CPU devices per-step cost is "
-                "program-launch-bound, so the wall-clock win comes "
-                "from the dispatch diet that rode this PR (greedy "
-                "decode no longer pays ~5 rng dispatches per step) "
-                "while the durable dp-sharding claim is structural: "
-                "each device computes max_batch/dp decode rows "
-                "instead of max_batch (4x less device work under "
-                "this plan, visible in the halved per-step "
-                "collective bytes in the plan's compile evidence) — "
-                "on a real slice, where compute dominates dispatch, "
-                "that ratio IS the speedup. The decode plan's "
-                "layout is separately pinned reshard-clean by the "
-                "serving_decode_planned analysis target.",
+                "program-launch-bound, so launch amortization is "
+                "measured at its most favorable; on a real slice "
+                "the prefill win approaches the lane-occupancy "
+                "ratio and the spec win approaches acceptance x "
+                "(launch_overhead / per-token compute). Both new "
+                "programs are pinned reshard-clean by the "
+                "serving_decode_planned and serving_prefill_planned "
+                "analysis targets.",
     }
     with open(args.out, "w", encoding="utf-8") as f:
         json.dump(doc, f, indent=1, sort_keys=True)
@@ -553,12 +642,18 @@ def main(argv=None) -> int:
                       "tokens_per_s": steady["tokens_per_s"],
                       "saturated_tokens_per_s":
                           saturated["tokens_per_s"],
-                      "speedup_vs_r01": (compared_to or {}).get(
+                      "spec_speedup_same_run":
+                          saturated["speedup_vs_per_token_same_run"],
+                      "spec_accepted_mean":
+                          saturated.get("spec_accepted_mean"),
+                      "prefill_tokens_per_s":
+                          prefill["batched"]["prefill_tokens_per_s"],
+                      "prefill_speedup_same_run":
+                          prefill["speedup_vs_sequential_same_run"],
+                      "speedup_vs_r02": (compared_to or {}).get(
                           "speedup"),
-                      "ttft_p99_s": steady["ttft_s"]["p99"],
                       "streamed_ttft_first_byte_s":
                           streaming["ttft_first_byte_s"],
-                      "max_in_flight": steady["max_in_flight"],
                       "goodput": preemption["goodput"]}))
     return 0
 

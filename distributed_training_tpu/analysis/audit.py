@@ -142,7 +142,8 @@ def _replicated_param_findings(target, trainer,
 
 def _audit_serving_target(target) -> dict:
     """Audit record for a ``kind="serving"`` target: the engine's
-    compiled decode program under the committed serving plan
+    compiled program — decode or batched prefill per
+    ``target.serving_objective`` — under the committed serving plan
     (serving/disagg.py lowers it — the SAME helper the planner's
     stage-2 serving verifier compiles, so the gated program is the
     consumed program). SPMD003 does not apply (no trainer state);
@@ -154,7 +155,8 @@ def _audit_serving_target(target) -> dict:
     from distributed_training_tpu.telemetry import collectives
 
     plan = load_plan(target.serving_plan)
-    text, warnings, mesh = compile_serving_hlo(plan, "decode")
+    text, warnings, mesh = compile_serving_hlo(
+        plan, getattr(target, "serving_objective", "decode"))
     coll = collectives.audit_hlo_text(text, mesh=mesh)
     coll["mesh"] = dict(target.mesh_axes)
     coll["spmd_reshard_warnings"] = len(warnings)

@@ -72,12 +72,15 @@ class AuditTarget:
     # Which program this target audits. "train" (default): the
     # jitted train step via the abstract Trainer. "serving": the
     # serving engine's compiled program under the committed plan
-    # named by ``serving_plan`` (serving/disagg.py lowers it — the
-    # decode objective's whole-batch one-token program). A KV-layout
+    # named by ``serving_plan`` (serving/disagg.py lowers it) —
+    # ``serving_objective`` picks which engine program: "decode"
+    # (the whole-table one-token program) or "prefill" (the batched
+    # multi-sequence lane program, SERVING_r03). A KV-layout
     # regression then goes tier-1 red with no accelerator, exactly
     # like a train-step reshard.
     kind: str = "train"
     serving_plan: str = ""
+    serving_objective: str = "decode"
     note: str = ""
 
 
@@ -204,18 +207,20 @@ def _register_planned_target() -> None:
     ))
 
 
-def _register_serving_decode_target() -> None:
-    """The committed serving DECODE plan's program as an audit
-    target: the paged-KV whole-batch decode step compiled under the
-    plan's layout (kv-head-sharded pool over tp). SPMD001 pinned to
-    zero — a paged-attention gather/scatter that starts replicating
-    the pool is the serving reshard cliff, and it must fail tier-1
-    without a chip. Same consume-the-plan-as-data discipline as the
-    planned train target."""
+def _register_serving_target(plan_file: str, name: str,
+                             objective: str, title: str,
+                             note: str) -> None:
+    """A committed serving plan's engine program as an audit target
+    (objective "decode": the paged-KV whole-batch decode step;
+    "prefill": the SERVING_r03 batched multi-sequence lane program —
+    dp-dealt lanes, per-lane page rows and masks). SPMD001 pinned to
+    zero — a paged-pool gather/scatter or lane-table scatter that
+    starts replicating is the serving reshard cliff, and it must
+    fail tier-1 without a chip. Same consume-the-plan-as-data
+    discipline as the planned train target."""
     path = os.path.join(
         os.path.dirname(os.path.dirname(os.path.dirname(
-            os.path.abspath(__file__)))), "conf", "plans",
-        "serving_8dev_cpu_decode.json")
+            os.path.abspath(__file__)))), "conf", "plans", plan_file)
     if not os.path.exists(path):
         return
     try:
@@ -227,9 +232,8 @@ def _register_serving_decode_target() -> None:
         # gate reports it loudly.
         return
     _register(AuditTarget(
-        name="serving_decode_planned",
-        title=f"serving paged-KV decode step "
-              f"(plan {plan['name']}@{plan['fingerprint']})",
+        name=name,
+        title=f"{title} (plan {plan['name']}@{plan['fingerprint']})",
         devices=plan["devices"],
         strategy=plan["base_strategy"],
         model="transformer",
@@ -240,18 +244,35 @@ def _register_serving_decode_target() -> None:
         pin_zero=("SPMD001",),
         kind="serving",
         serving_plan=plan["name"],
-        note="The committed serving decode plan "
-             "(conf/plans/serving_8dev_cpu_decode.json) compiled "
-             "through the engine's real decode program "
-             "(serving/engine.py via serving/disagg.py) — "
-             "benchmarks/bench_serving.py measures this exact "
-             "layout. Zero SPMD001 pinned: the paged KV pool must "
-             "never compile into a replicating layout.",
+        serving_objective=objective,
+        note=note,
     ))
 
 
 _register_planned_target()
-_register_serving_decode_target()
+_register_serving_target(
+    "serving_8dev_cpu_decode.json", "serving_decode_planned",
+    "decode", "serving paged-KV decode step",
+    note="The committed serving decode plan "
+         "(conf/plans/serving_8dev_cpu_decode.json) compiled "
+         "through the engine's real decode program "
+         "(serving/engine.py via serving/disagg.py) — "
+         "benchmarks/bench_serving.py measures this exact "
+         "layout. Zero SPMD001 pinned: the paged KV pool must "
+         "never compile into a replicating layout.",
+)
+_register_serving_target(
+    "serving_4dev_cpu_prefill.json", "serving_prefill_planned",
+    "prefill", "serving batched multi-sequence prefill",
+    note="The committed serving prefill plan "
+         "(conf/plans/serving_4dev_cpu_prefill.json) compiled "
+         "through the engine's real batched prefill program "
+         "(serving/engine.py build_prefill_batch_fn via "
+         "serving/disagg.py) — the program "
+         "benchmarks/bench_serving.py measures for SERVING_r03. "
+         "Zero SPMD001 pinned: the batched lane table must "
+         "never compile into a replicating layout.",
+)
 
 
 def resolve(names=None) -> list[AuditTarget]:

@@ -249,6 +249,11 @@ SERVING_MODEL_KWARGS = dict(vocab_size=256, d_model=64, n_heads=4,
                             pos_encoding="rope", dtype="float32",
                             param_dtype="float32")
 
+# The chunk width the prefill objective scores the batched lane
+# program at — engine_config_for_plan's default prefill_chunk, so the
+# scored program and the disagg pipeline's compiled program agree.
+SERVING_PREFILL_CHUNK = 16
+
 _register(PlanTarget(
     name="serving_8dev_cpu_decode",
     devices=8,
@@ -268,10 +273,12 @@ _register(PlanTarget(
     batch_candidates=(32,),
     objective="decode",
     note="The serving decode plan benchmarks/bench_serving.py lays "
-         "the engine out with (SERVING_r02): 32 decode slots dealt "
-         "over dp4 groups of 8, paged KV pool sharded dp×tp. "
-         "Audited reshard-clean by the serving_decode_planned "
-         "analysis target.",
+         "the engine out with (SERVING_r02/r03): 32 decode slots "
+         "dealt over dp4 groups of 8, paged KV pool sharded dp×tp; "
+         "r03's speculative multi-token decode rides the same "
+         "layout (the chunk program deals lanes over dp "
+         "identically). Audited reshard-clean by the "
+         "serving_decode_planned analysis target.",
 ))
 
 _register(PlanTarget(
@@ -282,13 +289,17 @@ _register(PlanTarget(
     optimizer="none",
     chip="cpu",
     hbm_gib=0.002,
-    batch_candidates=(1,),
+    batch_candidates=(8,),
     objective="prefill",
     note="Prefill-slice layout for the disaggregated pipeline "
-         "(serving/disagg.py): forward-only throughput objective "
-         "over half the 8-device CPU mesh; resolved against the SAME "
-         "model as serving_4dev_cpu_decode — two plans, one weight "
-         "store.",
+         "(serving/disagg.py): the BATCHED multi-sequence prefill "
+         "program (SERVING_r03) — 8 lanes dealt over the plan's dp "
+         "groups, one prompt chunk per lane per launch — scored for "
+         "aggregate prompt tokens/second over half the 8-device CPU "
+         "mesh; resolved against the SAME model as "
+         "serving_4dev_cpu_decode — two plans, one weight store. "
+         "Audited reshard-clean by the serving_prefill_planned "
+         "analysis target.",
 ))
 
 _register(PlanTarget(
@@ -305,8 +316,10 @@ _register(PlanTarget(
     batch_candidates=(16,),
     objective="decode",
     note="Decode-slice layout for the disaggregated pipeline: the KV "
-         "cache written by the prefill slice is handed off onto this "
-         "layout (serving/disagg.py) and decode continues there.",
+         "cache written by the prefill slice's batched lane program "
+         "is handed off onto this layout (serving/disagg.py) and "
+         "decode continues there (speculative multi-token capable, "
+         "SERVING_r03).",
 ))
 
 
@@ -756,9 +769,17 @@ def _score_serving(target: PlanTarget, cand: Candidate,
       trade that forces tp in once per-device params + pool stop
       fitting replicated, while dp soaks up the remaining devices
       for free throughput.
-    - **prefill**: forward-only chunk throughput — the train roofline
-      minus backward (no grad reduce-scatter, no optimizer state,
-      half the tp crossings), score = prompt tokens/second.
+    - **prefill**: the BATCHED multi-sequence prefill program
+      (serving/engine.py ``build_prefill_batch_fn``, SERVING_r03):
+      ``batch_per_shard`` is the aggregate LANE count, dealt over
+      ``dp`` exactly like the decode slot table (``slots % dp``
+      feasibility), each lane a ``SERVING_PREFILL_CHUNK``-token
+      prompt chunk. dp divides the lane compute, the prompt-KV pool,
+      and the per-group tp activation traffic with zero new
+      collectives (lanes are independent); tp pays the activation
+      all-reduces; fsdp pays a full weight all-gather per LAUNCH —
+      score = aggregate prompt tokens/second at full chunk occupancy
+      (slots × chunk per launch).
 
     Both use the same calibrated collective/matmul curves as the
     train objective (one cost model, three objectives).
@@ -827,25 +848,38 @@ def _score_serving(target: PlanTarget, cand: Candidate,
             by_kind["all-reduce"] = 2.0 * 2.0 * cfg.n_layers \
                 * slots_local * D * ab
         tokens = slots  # one token per sequence per step
-    else:  # prefill
-        act_dev = B_shard * S * (4 * D + 2 * cfg.d_ff) * ab
-        total = params_dev + act_dev
+    else:  # prefill — the batched multi-sequence lane program
+        slots = B_shard
+        if slots % cand.dp:
+            rec.update(feasible=False, reason="slots%dp", score=0.0)
+            return rec
+        slots_local = slots // cand.dp
+        C = SERVING_PREFILL_CHUNK
+        # The prefill engine writes prompt KV into its own paged
+        # pool (the disagg handoff's source) — same residency model
+        # as decode, at the lane table's width.
+        kv_dev = slots * S * kv_tok / (cand.dp * cand.tp)
+        act_dev = slots_local * C * (4 * D + 2 * cfg.d_ff) * ab
+        total = params_dev + kv_dev + act_dev
         rec["hbm_gib"] = round(total / 2**30, 6)
+        rec["kv_pool_gib"] = round(kv_dev / 2**30, 6)
         if total > budget:
             rec.update(feasible=False, reason="hbm", score=0.0)
             return rec
-        global_batch = B_shard * cand.dp * cand.fsdp
+        # One launch = every lane's C-token chunk; dp deals lanes
+        # (batch-parallel, zero new collectives), tp shards the
+        # per-lane math. Attention cost rides flops_per_token(S) —
+        # a continuation chunk attends up to S prefix positions.
         model = Transformer(cfg)
-        flops_step = (model.flops_per_token(S) / 3.0) * S \
-            * global_batch
-        flops_per_dev = flops_step / target.devices
+        flops_step = (model.flops_per_token(S) / 3.0) * C * slots
+        flops_per_dev = flops_step / (cand.dp * cand.tp)
         by_kind = {}
         if cand.fsdp > 1:
             by_kind["all-gather"] = n_params * ab
         if cand.tp > 1:
             by_kind["all-reduce"] = 2.0 * 2.0 * cfg.n_layers \
-                * B_shard * S * D * ab
-        tokens = global_batch * S
+                * slots_local * C * D * ab
+        tokens = slots * C  # full chunk occupancy per launch
 
     if calib is not None:
         compute_s = flops_per_dev / calib.achievable_flops_per_s(

@@ -41,7 +41,6 @@ from distributed_training_tpu.serving.engine import (
     Engine,
     EngineConfig,
     build_decode_fn,
-    build_prefill_fn,
 )
 
 logger = logging.getLogger(__name__)
@@ -285,15 +284,21 @@ def import_kv_batch(cache, items) -> None:
 
 
 def engine_config_for_plan(plan, page_size: int = 16,
-                           prefill_chunk: int = 16) -> EngineConfig:
+                           prefill_chunk: int = 16,
+                           prefill_mode: str = "batched",
+                           spec_k: int = 1) -> EngineConfig:
     """The ONE engine geometry a plan implies — shared by the bench,
-    the disagg pipeline, and the analysis audit target so they all
+    the disagg pipeline, and the analysis audit targets so they all
     compile the same program shapes. ``batch_per_shard`` is the
-    AGGREGATE decode slot count, dealt over the plan's ``dp`` groups
-    (serving/engine.py); ``num_pages`` is each group's pool shard,
-    sized so its own slots fit at full length — the whole-pool total
-    is the same HBM the replicated-table engine reserved, now
-    batch-sharded."""
+    AGGREGATE slot count, dealt over the plan's ``dp`` groups
+    (serving/engine.py) — decode slots for decode plans, prefill
+    lanes for prefill plans (``prefill_slots`` defaults to the same
+    table); ``num_pages`` is each group's pool shard, sized so its
+    own slots fit at full length — the whole-pool total is the same
+    HBM the replicated-table engine reserved, now batch-sharded.
+    ``prefill_mode``/``spec_k`` select the batched-prefill and
+    speculative-decode programs (SERVING_r03); the plan's layout is
+    program-agnostic — dp deals lanes, tp shards heads, either way."""
     slots = plan.batch_per_shard
     dp = plan.mesh.get("dp", 1)
     if slots % dp:
@@ -308,6 +313,8 @@ def engine_config_for_plan(plan, page_size: int = 16,
         num_pages=(slots // dp) * pages_per_seq + 1,
         max_seq_len=plan.seq_len,
         prefill_chunk=prefill_chunk,
+        prefill_mode=prefill_mode,
+        spec_k=spec_k,
         kv_axis="tp",
         dp_axis="dp")
 
@@ -482,14 +489,15 @@ class DisaggPipeline:
 def lower_serving_program(plan, objective: str):
     """Abstractly lower the engine's compiled program for ``plan``
     (objective "decode" → the dp-sharded group-batched decode
-    program; "prefill" → the paged continuation-chunk program) on a
-    fake CPU mesh with params laid out per the plan. Returns
-    ``(lowered, mesh)`` — no state materialized (ShapeDtypeStruct
-    inputs carrying the plan's NamedShardings, analysis/compile.py's
-    discipline). The program itself comes from the SAME builders the
-    engine compiles (serving/engine.py ``build_decode_fn``/
-    ``build_prefill_fn``), so the verified program and the served
-    program can never drift — shard_map over dp included."""
+    program; "prefill" → the BATCHED multi-sequence prefill program,
+    the served path since SERVING_r03) on a fake CPU mesh with params
+    laid out per the plan. Returns ``(lowered, mesh)`` — no state
+    materialized (ShapeDtypeStruct inputs carrying the plan's
+    NamedShardings, analysis/compile.py's discipline). The program
+    itself comes from the SAME builders the engine compiles
+    (serving/engine.py ``build_decode_fn``/
+    ``build_prefill_batch_fn``), so the verified program and the
+    served program can never drift — shard_map over dp included."""
     import dataclasses
 
     import jax
@@ -499,6 +507,8 @@ def lower_serving_program(plan, objective: str):
     from distributed_training_tpu.parallel.planner import (
         model_for_plan)
     from distributed_training_tpu.runtime import fake_cpu_runtime
+    from distributed_training_tpu.serving.engine import (
+        build_prefill_batch_fn)
 
     jax.config.update("jax_platforms", "cpu")
     model = model_for_plan(plan)
@@ -539,12 +549,19 @@ def lower_serving_program(plan, objective: str):
                 arr((G, B), jnp.bool_, grp),
                 arr((G, 2), jnp.uint32, grp))
     else:
-        fn = build_prefill_fn(c, ecfg, first=False, mesh=mesh)
-        args = (params, pool, pool, arr((G, Ppages), jnp.int32, grp),
-                arr((G,), jnp.bool_, grp),
-                arr((1, ecfg.prefill_chunk), jnp.int32),
-                jax.ShapeDtypeStruct((), jnp.int32, sharding=rep),
-                jax.ShapeDtypeStruct((), jnp.int32, sharding=rep))
+        # The batched prefill lane table: the plan's slot count dealt
+        # over dp, prefill_chunk tokens per lane — exactly the
+        # program Engine._run_prefill_batch launches.
+        fn = build_prefill_batch_fn(c, ecfg, mesh=mesh)
+        Sp = (ecfg.prefill_slots or ecfg.max_batch) // G
+        C = ecfg.prefill_chunk
+        args = (params, pool, pool,
+                arr((G, Sp, Ppages), jnp.int32, grp),
+                arr((G, Sp, C), jnp.int32, grp),
+                arr((G, Sp), jnp.int32, grp),
+                arr((G, Sp), jnp.int32, grp),
+                arr((G, Sp), jnp.bool_, grp),
+                arr((G, 2), jnp.uint32, grp))
     return fn.lower(*args), mesh
 
 
@@ -576,5 +593,5 @@ def compile_verify_serving(target, plan) -> dict:
         "collective_bytes_per_step": coll["bytes_per_step"],
         "total_collectives": coll["total_collectives"],
         "program": ("decode" if target.objective == "decode"
-                    else "prefill_cont"),
+                    else "prefill_batch"),
     }

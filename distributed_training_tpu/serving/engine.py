@@ -1,39 +1,56 @@
-"""Continuous-batching engine: admission queue → three jitted programs.
+"""Continuous-batching engine: admission queue → two jitted programs.
 
 The serving hot loop. Requests join and leave the running batch at
 every step (continuous batching — no head-of-line blocking behind the
-longest sequence in a static batch), against exactly THREE compiled
-programs whose shapes never change:
+longest sequence in a static batch), against TWO compiled programs
+whose shapes never change, both BATCH-SHARDED over the mesh's ``dp``
+axis (a ``shard_map`` manual over ``dp``; every other mesh axis —
+``tp``'s head shard in particular — stays under the SPMD partitioner
+via the ``auto`` axes):
 
-- **prefill, first chunk** — the prompt's first ``prefill_chunk``
-  tokens as ordinary causal self-attention (flash-eligible on TPU via
-  ops.attention), KV written into the sequence's pages;
-- **prefill, continuation chunk** — later chunks attend the pages
-  written so far plus themselves (ops/paged_attention.py chunk form);
-- **decode** — ONE token for the whole slot table, BATCH-SHARDED over
-  the mesh's ``dp`` axis: the table's ``max_batch`` slots are dealt
-  into ``dp`` groups of ``max_batch/dp``, each group decoding only its
-  own slots against its own KV pool shard. The program is a
-  ``shard_map`` manual over ``dp`` (every other mesh axis — ``tp``'s
-  head shard in particular — stays under the SPMD partitioner via the
-  ``auto`` axes), so decode rows never cross groups: aggregate decode
-  throughput scales with dp while per-token latency stays flat, and
-  dp adds ZERO new collectives (rows are independent).
+- **batched prefill** — up to ``prefill_slots`` sequences' CURRENT
+  prompt chunks in ONE launch: each dp group packs its own admitted
+  prompts into ``prefill_slots/dp`` lanes of ``prefill_chunk`` tokens
+  (per-lane page rows, start positions, valid counts, live masks —
+  the SERVING_r02 per-group ``q_pos=-1`` masking generalized to a
+  whole lane table) and writes their KV through one batched page-row
+  scatter; the next token of every prompt-completing lane is sampled
+  IN-PROGRAM, so completion reads a ``(G, slots)`` int32 block
+  instead of a vocab-sized logits block per prompt. This replaces the
+  one-sequence-per-launch prefill (which replicated a single chunk
+  across dp groups with the dead groups masked — the launch-bound
+  cost SERVING_r02's ledger recorded); that path survives as
+  ``prefill_mode="sequential"`` for same-run comparison benches and
+  the parity tests.
+- **decode** — the ``max_batch`` slot table dealt into ``dp`` groups
+  of ``max_batch/dp``, each group decoding only its own slots against
+  its own KV pool shard; dp adds ZERO new collectives (rows are
+  independent). With ``spec_k > 1`` the decode step is
+  MULTI-TOKEN SELF-SPECULATIVE: each slot drafts ``spec_k - 1``
+  tokens by prompt-lookup (the most recent earlier occurrence of the
+  sequence's own trailing n-gram — no second model), verifies the
+  whole chain in one batched forward (the same chunk program as
+  batched prefill, emitting the argmax at EVERY position), and emits
+  the accepted prefix. Greedy output is token-identical BY
+  CONSTRUCTION: every emitted token is the verified argmax given the
+  true prefix (a draft is accepted only when it equals the previous
+  position's argmax), so speculation changes launch count, never
+  tokens. Launch overhead amortizes by the acceptance length
+  (telemetry: ``spec_accepted_mean`` on step records,
+  ``Engine.spec_stats`` totals).
 
-Join/evict therefore never change a traced shape: admission fills a
-slot in ONE group and allocates pages from that group's shard;
-completion frees them; the programs compile once at warmup and never
-again (``compile_counts`` exposes the jit cache sizes so the bench can
+Join/evict never change a traced shape: admission fills a slot in ONE
+group and allocates pages from that group's shard; completion frees
+them; the programs compile once at warmup and never again
+(``compile_counts`` exposes the jit cache sizes so the bench can
 ASSERT zero recompiles mid-storm).
 
 Admission is dp-aware: the queue load-balances across groups —
 fewest-active-slots-first, pages permitting — so a burst cannot pile
 onto one shard while the others idle (pinned by test under a skewed
-arrival burst). Prefill runs one sequence per step as before; the
-chunk computation is replicated across dp groups (the SAME weights on
-every group — no cheaper layout exists for one sequence) but only the
-owning group's pool shard receives live writes (the others' land in
-their scratch page) and only its logits row is read.
+arrival burst). Under batched prefill a prefill step admits as many
+queued requests as slots+pages allow before launching (one admission
+per step would starve the lane table it just paid for).
 
 Scheduling policy (``EngineConfig.policy``):
 
@@ -90,13 +107,23 @@ class EngineConfig:
     ``max_batch`` is the AGGREGATE decode slot count across all dp
     groups; on a mesh whose ``dp_axis`` has extent G it must divide
     into G equal group-local tables. ``num_pages`` is the per-group
-    pool shard size (serving/kv_cache.py)."""
+    pool shard size (serving/kv_cache.py). ``prefill_slots`` is the
+    AGGREGATE lane count of the batched prefill program (0 = same as
+    ``max_batch``), dealt over dp exactly like the decode table.
+    ``spec_k`` is the tokens-per-decode-launch of the speculative
+    program (1 = the plain one-token decode; > 1 requires greedy
+    ``temperature == 0`` — acceptance verification is exact only for
+    the argmax chain)."""
 
     max_batch: int = 8            # decode slots, aggregate over dp
     page_size: int = 16
     num_pages: int = 128          # per dp group
     max_seq_len: int = 256        # per-sequence cap (prompt + new)
-    prefill_chunk: int = 32       # tokens per prefill step
+    prefill_chunk: int = 32       # tokens per prefill lane per step
+    prefill_slots: int = 0        # batched-prefill lanes (0 = max_batch)
+    prefill_mode: str = "batched"  # "batched" | "sequential" (r02 path)
+    spec_k: int = 1               # decode tokens per launch (1 = off)
+    spec_ngram: int = 3           # longest prompt-lookup n-gram tried
     policy: str = "prefill"       # "prefill" | "decode" priority
     temperature: float = 0.0
     top_k: int = 0
@@ -110,10 +137,26 @@ class EngineConfig:
             raise ValueError(
                 f"unknown scheduling policy '{self.policy}' "
                 "(expected 'prefill' or 'decode')")
+        if self.prefill_mode not in ("batched", "sequential"):
+            raise ValueError(
+                f"unknown prefill_mode '{self.prefill_mode}' "
+                "(expected 'batched' or 'sequential')")
         if self.prefill_chunk < 1:
             raise ValueError("prefill_chunk must be >= 1")
         if self.max_batch < 1:
             raise ValueError("max_batch must be >= 1")
+        if self.prefill_slots < 0:
+            raise ValueError("prefill_slots must be >= 0")
+        if self.spec_k < 1:
+            raise ValueError("spec_k must be >= 1")
+        if self.spec_ngram < 1:
+            raise ValueError("spec_ngram must be >= 1")
+        if self.spec_k > 1 and self.temperature > 0:
+            raise ValueError(
+                "speculative decode (spec_k > 1) requires greedy "
+                "temperature == 0 — the verification accepts exactly "
+                "the argmax chain, which has no sampled analogue "
+                "without rejection sampling")
 
 
 @dataclass
@@ -149,19 +192,21 @@ class _Seq:
 
 
 def _rope_bhd(x, positions):
-    """RoPE on (B, H, hd) with per-row absolute positions (B,) —
+    """RoPE on (..., H, hd) with per-row absolute positions (...) —
     the same freqs/rotation as models.transformer._rope (parity with
     the training stack is load-bearing: drift here is silent output
-    corruption, caught by the paged⇄dense test)."""
+    corruption, caught by the paged⇄dense test). The leading shape is
+    free: (B,) rows for the one-token decode, (S, C) lanes×positions
+    for the batched chunk program."""
     import jax.numpy as jnp
 
     D = x.shape[-1]
     half = D // 2
     freqs = 1.0 / (10000 ** (jnp.arange(half, dtype=jnp.float32)
                              / half))
-    angles = positions[:, None].astype(jnp.float32) * freqs[None, :]
-    cos = jnp.cos(angles)[:, None, :]
-    sin = jnp.sin(angles)[:, None, :]
+    angles = positions[..., None].astype(jnp.float32) * freqs
+    cos = jnp.cos(angles)[..., None, :]
+    sin = jnp.sin(angles)[..., None, :]
     x1, x2 = x[..., :half], x[..., half:]
     return jnp.concatenate([x1 * cos - x2 * sin,
                             x1 * sin + x2 * cos],
@@ -178,6 +223,42 @@ def _layer_norm(x, scale, bias):
     var = jnp.mean(jnp.square(x - mu), axis=-1, keepdims=True)
     y = (x - mu) * jax.lax.rsqrt(var + 1e-5)
     return (y * scale + bias).astype(dtype)
+
+
+def draft_tokens(history: np.ndarray, m: int,
+                 ngram_max: int = 3) -> np.ndarray:
+    """Prompt-lookup drafting: ``m`` speculative tokens from the
+    sequence's OWN history (prompt + generated) — no second model.
+
+    Finds the most recent EARLIER occurrence of the history's
+    trailing n-gram (longest n <= ngram_max first) and drafts the
+    tokens that followed it; short continuations pad with the last
+    token, and a history with no repeated n-gram drafts the last
+    token repeated. Draft quality only moves the ACCEPTANCE LENGTH —
+    never the output: verification emits exactly the argmax chain
+    regardless (serving/engine.py spec decode)."""
+    hist = np.asarray(history, np.int32)
+    L = hist.shape[0]
+    if m <= 0 or L == 0:
+        return np.zeros((max(0, m),), np.int32)
+    fill = int(hist[-1])
+    for n in range(min(ngram_max, L - 1), 0, -1):
+        pat = hist[L - n:]
+        # All windows starting strictly before the trailing n-gram
+        # itself (an occurrence needs at least one continuation
+        # token).
+        win = np.lib.stride_tricks.sliding_window_view(
+            hist, n)[:L - n]
+        matches = np.nonzero((win == pat).all(axis=1))[0]
+        if matches.size:
+            p = int(matches[-1])
+            cont = hist[p + n:p + n + m]
+            if cont.shape[0] < m:
+                cont = np.concatenate([
+                    cont, np.full((m - cont.shape[0],), fill,
+                                  np.int32)])
+            return cont.astype(np.int32)
+    return np.full((m,), fill, np.int32)
 
 
 # ---------------------------------------------------------------------------
@@ -288,6 +369,54 @@ def build_prefill_fn(model_cfg, ecfg: EngineConfig, first: bool,
     return jax.jit(body, donate_argnums=(1, 2), **kw)
 
 
+def _chunk_fn(model_cfg, ecfg: EngineConfig, emit: str, mesh=None):
+    """Jit the multi-lane chunk program (``_chunk_program``) for
+    (model, engine cfg, mesh). Signature (all group-batched, G = dp
+    extent, S = lanes per group, C = tokens per lane):
+    ``fn(params, k_pages, v_pages, page_rows (G, S, P),
+    tokens (G, S, C), start_pos (G, S), n_valid (G, S),
+    active (G, S), rng_data (G, 2)) -> (next_tokens, k_pages,
+    v_pages)`` where next_tokens is (G, S) for ``emit="last"`` (the
+    batched-prefill first-token sample) and (G, S, C) for
+    ``emit="all"`` (the speculative verification chain). Pools are
+    donated."""
+    import functools
+
+    import jax
+
+    body = functools.partial(
+        _chunk_program, cfg=model_cfg,
+        temperature=ecfg.temperature, top_k=ecfg.top_k,
+        paged_impl=ecfg.paged_impl, emit=emit)
+    kw = {}
+    if mesh is not None:
+        grp, pool = _out_shardings(model_cfg, ecfg, mesh)
+        kw["out_shardings"] = (grp, pool, pool)
+    if _dp_extent(mesh, ecfg.dp_axis) > 1:
+        body = _sharded(body, mesh, ecfg.dp_axis,
+                        n_grouped=8, n_replicated=0, n_outs=3)
+    return jax.jit(body, donate_argnums=(1, 2), **kw)
+
+
+def build_prefill_batch_fn(model_cfg, ecfg: EngineConfig, mesh=None):
+    """The jitted BATCHED multi-sequence prefill program: up to
+    ``prefill_slots/dp`` prompt chunks per group in one launch, each
+    lane writing its chunk's KV through the batched page-row scatter
+    and sampling its next token in-program (the first token of every
+    prompt-completing lane — read as one (G, S) int32 block, never a
+    vocab-sized logits transfer)."""
+    return _chunk_fn(model_cfg, ecfg, emit="last", mesh=mesh)
+
+
+def build_spec_decode_fn(model_cfg, ecfg: EngineConfig, mesh=None):
+    """The jitted MULTI-TOKEN speculative decode program: ``spec_k``
+    tokens per slot across the whole dealt slot table in one launch —
+    lane c's argmax is the verified next token GIVEN the drafted
+    prefix, so the host accepts exactly the prefix whose drafts match
+    the chain (greedy-token-identical by construction)."""
+    return _chunk_fn(model_cfg, ecfg, emit="all", mesh=mesh)
+
+
 class Engine:
     """The continuous-batching engine over one model + weight set.
 
@@ -324,6 +453,19 @@ class Engine:
                 f"{self.dp_groups} dp group(s) — the slot table is "
                 "dealt into equal group-local tables")
         self.batch_local = cfg.max_batch // self.dp_groups
+        prefill_slots = cfg.prefill_slots or cfg.max_batch
+        if prefill_slots % self.dp_groups:
+            raise ValueError(
+                f"prefill_slots ({prefill_slots}) must divide over "
+                f"the {self.dp_groups} dp group(s) — the prefill "
+                "lane table deals exactly like the decode table")
+        self.prefill_local = prefill_slots // self.dp_groups
+        # Speculative-decode accounting (the acceptance-length
+        # telemetry the bench ledgers): per-slot-launch totals, plus
+        # the last step's numbers for the step record.
+        self.spec_stats = {"launches": 0, "emitted": 0}
+        self._step_spec: tuple[int, int] | None = None
+        self._last_prefill_lanes: list[int] | None = None
         self.cache = PagedKVCache(
             PagedCacheConfig(
                 n_layers=model.cfg.n_layers,
@@ -354,50 +496,88 @@ class Engine:
 
     def _build_programs(self) -> None:
         c = self.model.cfg
-        self._decode_fn = build_decode_fn(c, self.cfg, self.mesh)
-        self._prefill_first_fn = build_prefill_fn(
-            c, self.cfg, first=True, mesh=self.mesh)
-        self._prefill_cont_fn = build_prefill_fn(
-            c, self.cfg, first=False, mesh=self.mesh)
+        if self.cfg.spec_k > 1:
+            # Multi-token decode IS the chunk program at C = spec_k
+            # (even an effective one-token launch — pages tight, or
+            # one token remaining — rides it with n_valid = 1: one
+            # program, one jit entry, zero recompiles).
+            self._decode_fn = build_spec_decode_fn(c, self.cfg,
+                                                   self.mesh)
+        else:
+            self._decode_fn = build_decode_fn(c, self.cfg, self.mesh)
+        if self.cfg.prefill_mode == "batched":
+            self._prefill_batch_fn = build_prefill_batch_fn(
+                c, self.cfg, mesh=self.mesh)
+        else:
+            self._prefill_first_fn = build_prefill_fn(
+                c, self.cfg, first=True, mesh=self.mesh)
+            self._prefill_cont_fn = build_prefill_fn(
+                c, self.cfg, first=False, mesh=self.mesh)
 
     def compile_counts(self) -> dict:
         """Jit-cache sizes per program — the bench's zero-recompile
         assertion compares this dict before/after the storm."""
-        return {
-            "decode": self._decode_fn._cache_size(),
-            "prefill_first": self._prefill_first_fn._cache_size(),
-            "prefill_cont": self._prefill_cont_fn._cache_size(),
-        }
+        counts = {"decode": self._decode_fn._cache_size()}
+        if self.cfg.prefill_mode == "batched":
+            counts["prefill_batch"] = \
+                self._prefill_batch_fn._cache_size()
+        else:
+            counts["prefill_first"] = \
+                self._prefill_first_fn._cache_size()
+            counts["prefill_cont"] = \
+                self._prefill_cont_fn._cache_size()
+        return counts
 
     def warmup(self) -> dict:
-        """Compile all three programs against scratch-only page rows
-        (zero allocator side effects: every write lands in each
-        group's scratch page). Returns compile_counts()."""
+        """Compile every program against scratch-only page rows and
+        all-dead lanes (zero allocator side effects: every write
+        lands in each group's scratch page). Returns
+        compile_counts()."""
         import jax.numpy as jnp
 
         G, B = self.dp_groups, self.batch_local
         P = self.cache.cfg.pages_per_seq
         C = self.cfg.prefill_chunk
-        zrows = jnp.zeros((G, B, P), jnp.int32)
-        toks = jnp.zeros((G, B), jnp.int32)
-        pos = jnp.zeros((G, B), jnp.int32)
-        act = jnp.zeros((G, B), jnp.bool_)
         rng = jnp.zeros((G, 2), jnp.uint32)
-        _t, k, v = self._decode_fn(self.params, self.cache.k_pages,
-                                   self.cache.v_pages, toks, pos,
-                                   zrows, act, rng)
+        if self.cfg.spec_k > 1:
+            _t, k, v = self._decode_fn(
+                self.params, self.cache.k_pages, self.cache.v_pages,
+                jnp.zeros((G, B, P), jnp.int32),
+                jnp.zeros((G, B, self.cfg.spec_k), jnp.int32),
+                jnp.zeros((G, B), jnp.int32),
+                jnp.zeros((G, B), jnp.int32),
+                jnp.zeros((G, B), jnp.bool_), rng)
+        else:
+            _t, k, v = self._decode_fn(
+                self.params, self.cache.k_pages, self.cache.v_pages,
+                jnp.zeros((G, B), jnp.int32),
+                jnp.zeros((G, B), jnp.int32),
+                jnp.zeros((G, B, P), jnp.int32),
+                jnp.zeros((G, B), jnp.bool_), rng)
         self.cache.update_pools(k, v)
-        ctoks = jnp.zeros((1, C), jnp.int32)
-        row = jnp.zeros((G, P), jnp.int32)
-        live = jnp.zeros((G,), jnp.bool_)
-        for fn in (self._prefill_first_fn, self._prefill_cont_fn):
-            # Plain-int scalars, matching the step loop's calls —
-            # a jnp.int32() here would warm a DIFFERENT (non-weak)
-            # jit entry than the one the storm hits.
-            _lg, k, v = fn(self.params, self.cache.k_pages,
-                           self.cache.v_pages, row, live, ctoks,
-                           0, 1)
+        if self.cfg.prefill_mode == "batched":
+            Sp = self.prefill_local
+            _t, k, v = self._prefill_batch_fn(
+                self.params, self.cache.k_pages, self.cache.v_pages,
+                jnp.zeros((G, Sp, P), jnp.int32),
+                jnp.zeros((G, Sp, C), jnp.int32),
+                jnp.zeros((G, Sp), jnp.int32),
+                jnp.zeros((G, Sp), jnp.int32),
+                jnp.zeros((G, Sp), jnp.bool_), rng)
             self.cache.update_pools(k, v)
+        else:
+            ctoks = jnp.zeros((1, C), jnp.int32)
+            row = jnp.zeros((G, P), jnp.int32)
+            live = jnp.zeros((G,), jnp.bool_)
+            for fn in (self._prefill_first_fn,
+                       self._prefill_cont_fn):
+                # Plain-int scalars, matching the step loop's calls —
+                # a jnp.int32() here would warm a DIFFERENT
+                # (non-weak) jit entry than the one the storm hits.
+                _lg, k, v = fn(self.params, self.cache.k_pages,
+                               self.cache.v_pages, row, live, ctoks,
+                               0, 1)
+                self.cache.update_pools(k, v)
         return self.compile_counts()
 
     # -- admission ---------------------------------------------------------
@@ -536,29 +716,56 @@ class Engine:
             kind = "decode" if decodable else (
                 "prefill" if want_prefill else "idle")
         tokens_out = 0
+        self._step_spec = None
+        self._last_prefill_lanes = None
         if kind == "prefill":
-            seq = pending[0] if pending else self._admit()
-            # Backpressure fallback: when admission OR a mid-prompt
-            # page allocation fails (pool exhausted), decode instead
-            # — decoding sequences finish and free the pages the
-            # prefill is waiting for. Without the second fallback a
-            # prefill-priority engine livelocks: step() would pick
-            # the stalled prefill forever and decode would never run
-            # (regression-pinned in tests/test_serving.py).
-            if seq is None or not self._run_prefill_chunk(seq):
-                kind = "decode" if decodable else "idle"
+            if self.cfg.prefill_mode == "batched":
+                # Admit everything slots+pages allow BEFORE the
+                # launch — one admission per step would starve the
+                # lane table the batched program pays for.
+                while self.queue and self._admit() is not None:
+                    pass
+                tokens_out = self._run_prefill_batch(
+                    self._prefill_candidates())
+                if tokens_out == 0:
+                    # Backpressure: every pending chunk stalled on
+                    # pages — decode so finishing sequences free
+                    # them (the r02 livelock fallback, batched).
+                    kind = "decode" if decodable else "idle"
+            else:
+                seq = pending[0] if pending else self._admit()
+                # Backpressure fallback: when admission OR a
+                # mid-prompt page allocation fails (pool exhausted),
+                # decode instead — decoding sequences finish and
+                # free the pages the prefill is waiting for. Without
+                # the second fallback a prefill-priority engine
+                # livelocks (regression-pinned in
+                # tests/test_serving.py).
+                if seq is None or not self._run_prefill_chunk(seq):
+                    kind = "decode" if decodable else "idle"
         if kind == "decode":
             tokens_out = self._run_decode(decodable)
         dur = time.monotonic() - t0
         # "op", not "kind": telemetry's record envelope owns "kind"
         # (the event name), and a colliding field would silently
         # relabel the whole record past the metrics observer.
+        # "tokens" counts NEW tokens for decode steps and PROMPT
+        # tokens processed for (batched) prefill steps — the metrics
+        # observer splits them into the decode/prefill tok/s gauges
+        # by "op".
         rec = {"op": kind, "dur_s": dur, "tokens": tokens_out,
                "in_flight": self.in_flight,
                "queue_depth": len(self.queue),
                **self.cache.occupancy()}
+        if self._step_spec is not None:
+            launches, emitted = self._step_spec
+            rec["spec_k"] = self.cfg.spec_k
+            rec["spec_accepted_mean"] = round(emitted / launches, 4)
         if self.dp_groups > 1:
             rec["group_slots_active"] = self.slots_active_by_group()
+            if self._last_prefill_lanes is not None:
+                rec["group_prefill_slots_active"] = \
+                    self._last_prefill_lanes
         event("serving", **rec)
         self._step_counter += 1
         return rec
@@ -601,10 +808,13 @@ class Engine:
         self.cache.advance(seq.req.id, n_valid)
         seq.prefilled = start + n_valid
         if seq.prefill_done:
-            # device_get the whole (G, V) block and slice on host:
-            # logits[g] on the dp-sharded array would be one more
-            # device dispatch per completed prompt.
-            tok = self._sample_host(np.asarray(logits)[g])
+            # Slice ON DEVICE before the pull: one (V,) transfer per
+            # completed prompt instead of the whole (G, V) block —
+            # the r02 dispatch-diet leftover (completion cost must
+            # not scale with vocab x dp). The batched prefill path
+            # goes further and never moves logits at all (in-program
+            # sampling).
+            tok = self._sample_host(np.asarray(logits[g]))
             now = time.monotonic()
             seq.first_token_t = now
             seq.token_times.append(now)
@@ -633,10 +843,192 @@ class Engine:
             lg = jnp.where(lg < kth, -jnp.inf, lg)
         return int(jax.random.categorical(rng, lg))
 
-    def _run_decode(self, decodable: list[_Seq]) -> int:
+    def _rng_grouped(self, salt: int):
+        """(G, 2) uint32 per-group key data for the compiled
+        programs' sampling tail. Greedy returns the cached zero key
+        (the operand is dead — the r02 dispatch diet)."""
         import jax
         import jax.numpy as jnp
 
+        if self.cfg.temperature <= 0:
+            return self._zero_rng
+        base = jax.random.fold_in(self._base_rng, salt)
+        return jnp.asarray(np.stack([
+            np.asarray(jax.random.key_data(
+                jax.random.fold_in(base, g)))
+            for g in range(self.dp_groups)]))
+
+    def _run_prefill_batch(self, pending: list[_Seq]) -> int:
+        """One launch of the batched prefill program: pack up to
+        ``prefill_local`` pending sequences PER GROUP (each lane is
+        one sequence's current chunk, pages ensured first), write all
+        their KV through one batched scatter, and read the in-program
+        sample for every lane whose chunk completed its prompt.
+        Returns the prompt tokens processed (0 = every pending chunk
+        stalled on pages — backpressure; the caller lets decode run
+        so pages free up)."""
+        import jax.numpy as jnp
+
+        c = self.cfg
+        G, Sp, C = self.dp_groups, self.prefill_local, c.prefill_chunk
+        chosen: list[list[_Seq]] = [[] for _ in range(G)]
+        for s in pending:
+            g = self.cache.group_of(s.req.id)
+            if len(chosen[g]) >= Sp:
+                continue
+            n = min(C, s.prompt_len - s.prefilled)
+            if not self.cache.ensure(s.req.id, s.prefilled + n):
+                continue  # this lane stalls; others still launch
+            chosen[g].append(s)
+        if not any(chosen):
+            return 0
+        tokens = np.zeros((G, Sp, C), np.int32)
+        start_pos = np.zeros((G, Sp), np.int32)
+        n_valid = np.zeros((G, Sp), np.int32)
+        active = np.zeros((G, Sp), bool)
+        for g, seqs in enumerate(chosen):
+            for i, s in enumerate(seqs):
+                start = s.prefilled
+                n = min(C, s.prompt_len - start)
+                tokens[g, i, :n] = s.req.prompt[start:start + n]
+                start_pos[g, i] = start
+                n_valid[g, i] = n
+                active[g, i] = True
+        rows = self.cache.page_rows_grouped(
+            [[s.req.id for s in seqs] for seqs in chosen], width=Sp)
+        nxt, k, v = self._prefill_batch_fn(
+            self.params, self.cache.k_pages, self.cache.v_pages,
+            jnp.asarray(rows), jnp.asarray(tokens),
+            jnp.asarray(start_pos), jnp.asarray(n_valid),
+            jnp.asarray(active),
+            self._rng_grouped(1_000_000 + self._step_counter))
+        self.cache.update_pools(k, v)
+        self._last_prefill_lanes = [len(seqs) for seqs in chosen]
+        total = 0
+        fetched = None
+        now = None
+        for g, seqs in enumerate(chosen):
+            for i, s in enumerate(seqs):
+                n = int(n_valid[g, i])
+                self.cache.advance(s.req.id, n)
+                s.prefilled += n
+                total += n
+                if s.prefill_done:
+                    if fetched is None:
+                        # ONE (G, Sp) int32 pull for the whole
+                        # launch, and only when some prompt
+                        # completed — never a logits block. The
+                        # timestamp is taken AFTER this blocking
+                        # fetch: under async dispatch an earlier
+                        # clock read would exclude the launch's own
+                        # compute from TTFT.
+                        fetched = np.asarray(nxt)
+                        now = time.monotonic()
+                    tok = int(fetched[g, i])
+                    s.first_token_t = now
+                    s.token_times.append(now)
+                    s.generated.append(tok)
+                    self._emit_token(s, tok)
+                    self._maybe_finish(s)
+        return total
+
+    def _draft(self, seq: _Seq, m: int) -> np.ndarray:
+        """``m`` drafted tokens for ``seq`` by prompt lookup over its
+        own history (prompt + generated) — see ``draft_tokens``."""
+        if m <= 0:
+            return np.zeros((0,), np.int32)
+        hist = np.concatenate([
+            np.asarray(seq.req.prompt, np.int32),
+            np.asarray(seq.generated, np.int32)])
+        return draft_tokens(hist, m, self.cfg.spec_ngram)
+
+    def _run_decode_spec(self, decodable: list[_Seq]) -> int:
+        """One launch of the speculative multi-token decode program:
+        every decodable slot carries [last sampled token, spec_k - 1
+        drafted tokens], the program argmax-verifies all positions in
+        one forward, and the host emits the accepted prefix — each
+        emitted token IS the argmax given the true prefix, so greedy
+        output is token-identical to one-token decode. The cache
+        advances only by the accepted length; rejected positions'
+        stale KV sits beyond ``length`` (masked out of attention) and
+        is overwritten by the next launch's writes."""
+        import jax.numpy as jnp
+
+        G, B = self.dp_groups, self.batch_local
+        K = self.cfg.spec_k
+        tokens = np.zeros((G, B, K), np.int32)
+        start_pos = np.zeros((G, B), np.int32)
+        n_valid = np.zeros((G, B), np.int32)
+        active = np.zeros((G, B), bool)
+        seq_ids: list[list] = [[None] * B for _ in range(G)]
+        stepped: list[tuple[_Seq, int, np.ndarray]] = []
+        for s in decodable:
+            length = self.cache.length(s.req.id)
+            remaining = s.req.max_new_tokens - len(s.generated)
+            # Clamp the chain to what the sequence can still hold —
+            # positions past max_seq_len or past the request's budget
+            # ride as masked padding (n_valid), never as writes.
+            n = min(K, remaining, self.cfg.max_seq_len - length)
+            if not self.cache.ensure(s.req.id, length + n):
+                # Pages for the full chain are short: fall back to a
+                # one-token launch in the SAME program before
+                # stalling outright.
+                if n == 1 or not self.cache.ensure(s.req.id,
+                                                   length + 1):
+                    continue
+                n = 1
+            g, i = divmod(s.slot, B)
+            draft = self._draft(s, n - 1)
+            tokens[g, i, 0] = s.generated[-1]
+            if n > 1:
+                tokens[g, i, 1:n] = draft
+            start_pos[g, i] = length
+            n_valid[g, i] = n
+            active[g, i] = True
+            seq_ids[g][i] = s.req.id
+            stepped.append((s, n, draft))
+        if not stepped:
+            return 0
+        rows = self.cache.page_rows_grouped(seq_ids)
+        out, k, v = self._decode_fn(
+            self.params, self.cache.k_pages, self.cache.v_pages,
+            jnp.asarray(rows), jnp.asarray(tokens),
+            jnp.asarray(start_pos), jnp.asarray(n_valid),
+            jnp.asarray(active), self._zero_rng)
+        self.cache.update_pools(k, v)
+        out = np.asarray(out)
+        now = time.monotonic()
+        total = 0
+        for s, n, draft in stepped:
+            g, i = divmod(s.slot, B)
+            # out[g, i, j] is the verified argmax AFTER position j.
+            # Accept draft j while it equals the chain's previous
+            # token; every accepted position's argmax is then
+            # conditioned on true tokens only.
+            emit = [int(out[g, i, 0])]
+            j = 1
+            while j < n and int(draft[j - 1]) == emit[-1]:
+                emit.append(int(out[g, i, j]))
+                j += 1
+            self.cache.advance(s.req.id, len(emit))
+            self.spec_stats["launches"] += 1
+            self.spec_stats["emitted"] += len(emit)
+            for tok in emit:
+                s.generated.append(tok)
+                if s.first_token_t is None:
+                    s.first_token_t = now
+                s.token_times.append(now)
+                self._emit_token(s, tok)
+            total += len(emit)
+            self._maybe_finish(s)
+        self._step_spec = (len(stepped), total)
+        return total
+
+    def _run_decode(self, decodable: list[_Seq]) -> int:
+        import jax.numpy as jnp
+
+        if self.cfg.spec_k > 1:
+            return self._run_decode_spec(decodable)
         G, B = self.dp_groups, self.batch_local
         tokens = np.zeros((G, B), np.int32)
         positions = np.zeros((G, B), np.int32)
@@ -660,15 +1052,7 @@ class Engine:
         if not stepped:
             return 0
         rows = self.cache.page_rows_grouped(seq_ids)
-        if self.cfg.temperature <= 0:
-            rng = self._zero_rng          # greedy: operand is dead
-        else:
-            base = jax.random.fold_in(self._base_rng,
-                                      self._step_counter)
-            rng = jnp.asarray(np.stack([
-                np.asarray(jax.random.key_data(
-                    jax.random.fold_in(base, g)))
-                for g in range(G)]))
+        rng = self._rng_grouped(self._step_counter)
         nxt, k, v = self._decode_fn(
             self.params, self.cache.k_pages, self.cache.v_pages,
             jnp.asarray(tokens), jnp.asarray(positions),
@@ -1049,3 +1433,142 @@ def _prefill_program(params, k_pages, v_pages, page_row, live,
     logits = jnp.einsum("d,dv->v", x_last,
                         head.astype(dt)).astype(jnp.float32)
     return logits[None], k_pages_g[None], v_pages_g[None]
+
+
+def _chunk_program(params, k_pages, v_pages, page_rows, tokens,
+                   start_pos, n_valid, active, rng_data, *, cfg,
+                   temperature, top_k, paged_impl, emit):
+    """Multi-token chunks for a whole lane table, one dp group.
+
+    The ONE program body behind both batched prefill (``emit="last"``,
+    S = prefill lanes, C = prefill_chunk) and speculative multi-token
+    decode (``emit="all"``, S = decode slots, C = spec_k) — the math
+    is identical: write every lane's C tokens' KV into its pages
+    through one batched scatter, then attend each query to its own
+    pages at positions <= its own (the paged chunk form — for a
+    first chunk that reduces to causal self-attention, for decode it
+    verifies the drafted chain exactly as sequential steps would).
+
+    k_pages/v_pages (1, L, Hkv, N, ps, hd) — the group's pool shard;
+    page_rows (1, S, P); tokens (1, S, C) int32 (positions >=
+    n_valid[s] are padding); start_pos (1, S) — each lane's first
+    ABSOLUTE position; n_valid (1, S) — valid tokens per lane;
+    active (1, S) bool — dead lanes write to the scratch page and
+    their queries mask out via q_pos = -1; rng_data (1, 2).
+
+    Returns ``(next_tokens, k_pages, v_pages)``:
+
+    - ``emit="last"``: next_tokens (1, S) int32 — the SAMPLED token
+      after each lane's last valid position (argmax at temperature 0,
+      per-lane categorical otherwise) — meaningful when the lane's
+      chunk completes its prompt;
+    - ``emit="all"``: next_tokens (1, S, C) int32 — the ARGMAX after
+      EVERY position (position c's argmax is the verified next token
+      given tokens[:c+1]); the host accepts the longest prefix whose
+      drafts match the chain. Always greedy (EngineConfig forbids
+      spec_k > 1 with temperature > 0).
+
+    Inactive lanes' outputs are 0.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_training_tpu.ops.paged_attention import (
+        paged_attention_chunk)
+
+    del paged_impl  # chunk form has no kernel path yet
+    k_pages_g, v_pages_g = k_pages[0], v_pages[0]
+    page_rows, tokens = page_rows[0], tokens[0]
+    start_pos, n_valid, active = start_pos[0], n_valid[0], active[0]
+    dt = jnp.dtype(cfg.dtype)
+    S, C = tokens.shape
+    P = page_rows.shape[1]
+    ps = k_pages_g.shape[3]
+    idx = jnp.arange(C, dtype=jnp.int32)
+    abs_pos = start_pos[:, None] + idx[None, :]           # (S, C)
+    valid = (idx[None, :] < n_valid[:, None]) & active[:, None]
+    x = params["tok_embed"][tokens].astype(dt)            # (S, C, D)
+    if cfg.pos_encoding == "learned":
+        safe = jnp.minimum(abs_pos, cfg.max_seq_len - 1)
+        x = x + params["pos_embed"][safe].astype(dt)
+    # Page coordinates per (lane, position); dead writes → each
+    # group's scratch page 0 (page index clamped first: padding
+    # positions of a lane near max_seq_len could index past its row).
+    logical = jnp.minimum(abs_pos // ps, P - 1)
+    page_ids = jnp.where(
+        valid, jnp.take_along_axis(page_rows, logical, axis=1), 0)
+    offsets = jnp.where(valid, abs_pos % ps, 0)
+    q_pos = jnp.where(valid, abs_pos, -1)                 # (S, C)
+    stacked = {k: params[k] for k in _STACKED}
+
+    def layer_body(x, inp):
+        layer, kp, vp = inp
+        h = _layer_norm(x, layer["ln1"]["scale"],
+                        layer["ln1"]["bias"])
+        q = jnp.einsum("scd,dhk->schk", h,
+                       layer["attn"]["wq"].astype(dt))
+        k = jnp.einsum("scd,dhk->schk", h,
+                       layer["attn"]["wk"].astype(dt))
+        v = jnp.einsum("scd,dhk->schk", h,
+                       layer["attn"]["wv"].astype(dt))
+        if cfg.pos_encoding == "rope":
+            q = _rope_bhd(q, abs_pos)
+            k = _rope_bhd(k, abs_pos)
+        # One batched scatter for the whole lane table: flatten
+        # (lane, position) — live coordinates never collide (a page
+        # is owned by exactly one sequence and a lane's positions are
+        # distinct); scratch collisions write garbage over garbage.
+        Hkv, hd = k.shape[2], k.shape[3]
+        kp, vp = _write_kv(kp, vp,
+                           k.reshape(S * C, Hkv, hd).astype(kp.dtype),
+                           v.reshape(S * C, Hkv, hd).astype(vp.dtype),
+                           page_ids.reshape(-1), offsets.reshape(-1))
+        attn = paged_attention_chunk(q, kp, vp, page_rows, q_pos)
+        x = x + jnp.einsum("schk,hkd->scd", attn,
+                           layer["attn"]["wo"].astype(dt))
+        h = _layer_norm(x, layer["ln2"]["scale"],
+                        layer["ln2"]["bias"])
+        m = layer["mlp"]
+        u = jax.nn.gelu(jnp.einsum("scd,df->scf", h,
+                                   m["wi"].astype(dt))
+                        + m["bi"].astype(dt))
+        x = x + (jnp.einsum("scf,fd->scd", u, m["wo"].astype(dt))
+                 + m["bo"].astype(dt))
+        return x, (kp, vp)
+
+    x, (k_pages_g, v_pages_g) = jax.lax.scan(
+        layer_body, x, (stacked, k_pages_g, v_pages_g))
+    head = (params["tok_embed"].T if cfg.tie_embeddings
+            else params["lm_head"])
+    if emit == "all":
+        # The verification chain: logits at EVERY position, argmax
+        # only (spec decode is greedy by config contract).
+        xs = _layer_norm(x, params["final_norm"]["scale"],
+                         params["final_norm"]["bias"])
+        logits = jnp.einsum("scd,dv->scv", xs,
+                            head.astype(dt)).astype(jnp.float32)
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return (jnp.where(valid, nxt, 0)[None],
+                k_pages_g[None], v_pages_g[None])
+    # emit == "last": each lane's LAST VALID position only — the
+    # vocab-sized logits never leave the program.
+    last = jnp.maximum(n_valid - 1, 0)[:, None, None]     # (S, 1, 1)
+    x_last = jnp.take_along_axis(
+        x, jnp.broadcast_to(last, (S, 1, x.shape[-1])), axis=1)[:, 0]
+    x_last = _layer_norm(x_last, params["final_norm"]["scale"],
+                         params["final_norm"]["bias"])
+    logits = jnp.einsum("sd,dv->sv", x_last,
+                        head.astype(dt)).astype(jnp.float32)
+    if temperature <= 0:
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    else:
+        lg = logits / temperature
+        if top_k:
+            kth = jax.lax.top_k(lg, top_k)[0][..., -1:]
+            lg = jnp.where(lg < kth, -jnp.inf, lg)
+        keys = jax.random.split(
+            jax.random.wrap_key_data(rng_data[0]), S)
+        nxt = jax.vmap(jax.random.categorical)(keys, lg).astype(
+            jnp.int32)
+    return (jnp.where(active, nxt, 0)[None],
+            k_pages_g[None], v_pages_g[None])

@@ -317,15 +317,23 @@ class PagedKVCache:
                 rows[i] = self.page_row(sid)
         return rows
 
-    def page_rows_grouped(self, seq_ids_by_group: list) -> np.ndarray:
-        """(dp_groups, B_local, pages_per_seq) int32 tables from a
-        per-group nested id list — the decode program's layout (group
-        g's rows index ONLY group g's pool shard)."""
-        b = len(seq_ids_by_group[0]) if seq_ids_by_group else 0
+    def page_rows_grouped(self, seq_ids_by_group: list,
+                          width: int | None = None) -> np.ndarray:
+        """(dp_groups, width, pages_per_seq) int32 tables from a
+        per-group nested id list — the batched programs' layout
+        (group g's rows index ONLY group g's pool shard). Lists may
+        be RAGGED (the batched prefill packs however many lanes each
+        group has pending): short groups pad with all-scratch rows up
+        to ``width`` (default: the longest group's length — the
+        decode path passes equal full-width lists)."""
+        b = width if width is not None else max(
+            (len(ids) for ids in seq_ids_by_group), default=0)
         rows = np.zeros((self.cfg.dp_groups, b,
                          self.cfg.pages_per_seq), np.int32)
         for g, ids in enumerate(seq_ids_by_group):
-            rows[g] = self.page_rows(ids)
+            for i, sid in enumerate(ids):
+                if sid is not None:
+                    rows[g, i] = self.page_row(sid)
         return rows
 
     def update_pools(self, k_pages, v_pages) -> None:

@@ -404,10 +404,15 @@ def engine_config_from_yaml(plan, engine_block: dict):
         page_size=int(engine_block.get("page_size", 16)),
         prefill_chunk=int(engine_block.get("prefill_chunk", 16)))
     # 0 / empty = "keep the plan-derived value" for every knob
-    # (temperature 0 IS the plan-derived greedy default).
+    # (temperature 0 IS the plan-derived greedy default;
+    # prefill_slots 0 means "same table as max_batch" and spec_k 1
+    # is plain one-token decode, so both pass through replace()
+    # harmlessly when set).
     over = {k: v for k, v in engine_block.items()
             if k in ("max_batch", "num_pages", "max_seq_len",
-                     "policy", "temperature", "top_k")
+                     "policy", "temperature", "top_k",
+                     "prefill_slots", "prefill_mode", "spec_k",
+                     "spec_ngram")
             and v not in (0, 0.0, None, "")}
     return dataclasses.replace(base, **over)
 

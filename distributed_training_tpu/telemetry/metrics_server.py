@@ -183,7 +183,22 @@ class MetricsServer:
                 toks = rec.get("tokens")
                 if isinstance(dur, (int, float)) and dur > 0 \
                         and isinstance(toks, (int, float)) and toks:
-                    self._gauges["serving_tokens_per_s"] = toks / dur
+                    # "tokens" means NEW tokens on decode steps and
+                    # PROMPT tokens on (batched) prefill steps
+                    # (serving/engine.py step records) — two gauges,
+                    # split by op.
+                    if rec.get("op") == "prefill":
+                        self._gauges["serving_prefill_tokens_per_s"] \
+                            = toks / dur
+                    else:
+                        self._gauges["serving_tokens_per_s"] = \
+                            toks / dur
+                if isinstance(rec.get("spec_accepted_mean"),
+                              (int, float)):
+                    # Speculative decode acceptance length (tokens
+                    # emitted per slot-launch, serving/engine.py).
+                    self._gauges["serving_spec_accepted_mean"] = \
+                        float(rec["spec_accepted_mean"])
                 # Per-dp-group shard gauges (the dp-sharded engine's
                 # step records carry per-group lists — serving/
                 # engine.py + kv_cache.occupancy; schema pinned by
@@ -191,6 +206,8 @@ class MetricsServer:
                 for src, dst in (
                         ("group_slots_active",
                          "serving_group_slots_active"),
+                        ("group_prefill_slots_active",
+                         "serving_group_prefill_slots_active"),
                         ("group_pages_used",
                          "serving_group_kv_pages_used"),
                         ("group_seqs", "serving_group_seqs")):
@@ -287,9 +304,19 @@ class MetricsServer:
                                 "completed request",
         "serving_tokens_per_s": "Decode throughput of the last "
                                 "engine step",
+        "serving_prefill_tokens_per_s": "Aggregate prompt tokens/s "
+                                        "of the last batched "
+                                        "prefill step",
+        "serving_spec_accepted_mean": "Speculative decode mean "
+                                      "accepted chain length, last "
+                                      "decode step",
         "serving_requests_total": "Requests completed by the engine",
         "serving_group_slots_active": "Active decode slots per dp "
                                       "group (dp-sharded engine)",
+        "serving_group_prefill_slots_active": "Batched-prefill lanes "
+                                              "live per dp group in "
+                                              "the last prefill "
+                                              "launch",
         "serving_group_kv_pages_used": "KV pages allocated in each "
                                        "dp group's pool shard",
         "serving_group_seqs": "Sequences resident per dp group",

@@ -1012,6 +1012,337 @@ def test_serving_r02_ledger_committed_and_coherent():
     assert 0 < pre["goodput"] <= 1
 
 
+# ---------------------------------------------------------------------------
+# batched multi-sequence prefill + speculative decode (SERVING_r03)
+# ---------------------------------------------------------------------------
+
+
+def _ragged_prompts():
+    """Prompt lengths chosen to hit every chunk-tail shape at
+    prefill_chunk=8: shorter than a chunk, exactly one chunk, one
+    chunk + tail, and multiple chunks + tail."""
+    return [np.asarray([5, 7, 11], np.int32),
+            np.asarray(np.arange(8), np.int32),
+            np.asarray([5, 7, 11, 13, 17, 19, 23, 29, 31, 37],
+                       np.int32),
+            np.asarray(([3, 9, 27] * 7)[:20], np.int32)]
+
+
+def test_batched_prefill_matches_sequential_and_full_context(
+        tiny_model):
+    """The tentpole prefill pin: the batched lane program (many
+    prompts' chunks per launch, ragged tails included) produces
+    token-for-token what BOTH the r02 sequential path and the
+    full-context ``model.apply`` reference produce."""
+    model, params = tiny_model
+    prompts = _ragged_prompts()
+
+    def run(mode):
+        eng = _engine(model, params, prefill_mode=mode, num_pages=96)
+        counts = eng.warmup()
+        for i, p in enumerate(prompts):
+            eng.submit(Request(id=f"r{i}", prompt=p,
+                               max_new_tokens=10))
+        eng.run_until_drained()
+        assert eng.compile_counts() == counts, \
+            f"{mode} prefill changed a traced shape"
+        return {r["id"]: r["tokens"] for r in eng.completed}
+
+    batched = run("batched")
+    assert batched == run("sequential")
+    for i, p in enumerate(prompts):
+        assert batched[f"r{i}"] == _full_context_greedy(
+            model, params, p, 10), f"prompt {i} diverged"
+
+
+def test_batched_prefill_packs_many_prompts_per_launch(tiny_model):
+    """The launch-amortization mechanism itself: once admitted, ONE
+    prefill step advances EVERY pending single-chunk prompt (the
+    sequential path needed one launch each)."""
+    model, params = tiny_model
+    eng = _engine(model, params, max_batch=6, num_pages=96)
+    eng.warmup()
+    prompts = [np.asarray([i + 1, i + 2, i + 3], np.int32)
+               for i in range(6)]
+    for i, p in enumerate(prompts):
+        eng.submit(Request(id=f"r{i}", prompt=p, max_new_tokens=4))
+    rec = eng.step()
+    assert rec["op"] == "prefill"
+    # One launch prefilled all six 3-token prompts (and sampled each
+    # one's first token in-program).
+    assert rec["tokens"] == sum(len(p) for p in prompts)
+    assert all(s is None or s.prefill_done for s in eng.slots)
+    assert all(len(s.generated) == 1 for s in eng.slots
+               if s is not None)
+
+
+def test_batched_prefill_cross_group_parity(serving_model,
+                                            sharded_engine):
+    """Batched prefill on the dp-sharded engine: each group packs
+    ITS OWN admitted prompts into its lane shard — tokens must match
+    the unsharded single-group engine exactly (lanes, groups, and
+    chunk tails are invisible to the output)."""
+    import dataclasses
+
+    model, params = serving_model
+    eng, _plan = sharded_engine
+    rng = np.random.default_rng(31)
+    prompts = [rng.integers(0, 256, size=int(rng.integers(3, 24)))
+               .astype(np.int32) for _ in range(10)]
+    for i, p in enumerate(prompts):
+        eng.submit(Request(id=f"pf{i}", prompt=p, max_new_tokens=6))
+    sharded = _drain_clean(eng)
+    ref = Engine(model, params, dataclasses.replace(
+        eng.cfg,
+        num_pages=eng.dp_groups * (eng.cfg.num_pages - 1) + 1))
+    for i, p in enumerate(prompts):
+        ref.submit(Request(id=f"pf{i}", prompt=p, max_new_tokens=6))
+    want = _drain_clean(ref)
+    assert {k: v["tokens"] for k, v in sharded.items()} == \
+        {k: v["tokens"] for k, v in want.items()}
+
+
+def test_spec_decode_token_identity_and_acceptance(tiny_model):
+    """The tentpole decode pin: speculative multi-token decode emits
+    EXACTLY the one-token-per-launch greedy stream (acceptance is
+    verification, not sampling), and the acceptance accounting adds
+    up — emitted tokens across launches equal the decode-emitted
+    tokens, with the mean in [1, spec_k]."""
+    model, params = tiny_model
+    prompts = _ragged_prompts()
+
+    def run(k):
+        eng = _engine(model, params, spec_k=k, num_pages=96)
+        counts = eng.warmup()
+        for i, p in enumerate(prompts):
+            eng.submit(Request(id=f"r{i}", prompt=p,
+                               max_new_tokens=12))
+        eng.run_until_drained()
+        assert eng.compile_counts() == counts, \
+            f"spec_k={k} decode changed a traced shape"
+        return {r["id"]: r["tokens"] for r in eng.completed}, eng
+
+    plain, _ = run(1)
+    for k in (3, 5):
+        spec, eng = run(k)
+        assert spec == plain, f"spec_k={k} changed tokens"
+        st = eng.spec_stats
+        assert st["launches"] > 0
+        # Every request's first token comes from prefill; the rest
+        # are decode-emitted.
+        decode_tokens = sum(len(t) - 1 for t in spec.values())
+        assert st["emitted"] == decode_tokens
+        mean = st["emitted"] / st["launches"]
+        assert 1.0 <= mean <= k
+        # Speculation must amortize launches: strictly fewer
+        # slot-launches than decode-emitted tokens (acceptance > 1
+        # on this repetitive tiny model).
+        assert st["launches"] < decode_tokens
+
+
+def test_spec_decode_respects_budget_and_seq_cap(tiny_model):
+    """Chain clamping: a request one token from its budget, and one
+    whose prompt + budget exactly fills max_seq_len, must finish
+    token-identically under spec_k > 1 (padding lanes, never
+    out-of-range writes)."""
+    model, params = tiny_model
+    prompt = np.asarray([5, 7, 11, 13], np.int32)
+
+    def run(k, n_new, max_seq):
+        eng = _engine(model, params, spec_k=k, max_seq_len=max_seq,
+                      num_pages=96)
+        eng.warmup()
+        eng.submit(Request(id="edge", prompt=prompt,
+                           max_new_tokens=n_new))
+        eng.run_until_drained()
+        (rec,) = eng.completed
+        assert eng.cache.pages_used == 0
+        return rec["tokens"]
+
+    for n_new, max_seq in ((1, 64), (2, 64), (12, 16), (11, 16)):
+        assert run(6, n_new, max_seq) == run(1, n_new, max_seq)
+
+
+def test_spec_requires_greedy():
+    with pytest.raises(ValueError, match="greedy"):
+        EngineConfig(spec_k=2, temperature=0.7)
+    with pytest.raises(ValueError, match="prefill_mode"):
+        EngineConfig(prefill_mode="eager")
+    with pytest.raises(ValueError, match="spec_k"):
+        EngineConfig(spec_k=0)
+
+
+def test_prompt_lookup_draft():
+    """The drafting policy: most recent earlier occurrence of the
+    trailing n-gram wins; continuations pad with the last token;
+    no-match histories draft the last token repeated. Draft quality
+    never touches correctness (verification owns the output) — this
+    pins the LOOKUP so acceptance behavior is deterministic."""
+    from distributed_training_tpu.serving.engine import draft_tokens
+
+    h = np.asarray([1, 2, 3, 9, 1, 2, 3, 7, 1, 2, 3], np.int32)
+    # Trailing [1,2,3]: most recent earlier occurrence at index 4 →
+    # continuation [7, 1, 2].
+    assert draft_tokens(h, 3, 3).tolist() == [7, 1, 2]
+    # m longer than the continuation: pad with the last token.
+    assert draft_tokens(h, 8, 3).tolist() == [7, 1, 2, 3, 3, 3, 3, 3]
+    # No repeated n-gram anywhere: repeat the last token.
+    assert draft_tokens(np.asarray([4, 5, 6], np.int32),
+                        2, 3).tolist() == [6, 6]
+    # Falls back to shorter n-grams when the long one never repeats.
+    h2 = np.asarray([8, 1, 9, 2, 9, 3, 9], np.int32)
+    assert draft_tokens(h2, 2, 3).tolist() == [3, 9]
+    assert draft_tokens(h2, 0, 3).tolist() == []
+
+
+def test_sharded_engine_emits_prefill_gauges(serving_model,
+                                             tmp_path):
+    """The per-dp-group PREFILL gauges (SERVING_r03 satellite):
+    batched prefill steps carry per-group live-lane counts and an
+    aggregate prompt tok/s, exported as labeled /metrics rows
+    additive next to the decode set."""
+    import urllib.request
+
+    from distributed_training_tpu.parallel.planner import load_plan
+    from distributed_training_tpu.runtime import MeshSpec, build_mesh
+    from distributed_training_tpu.serving.disagg import (
+        engine_config_for_plan, place_params)
+    from distributed_training_tpu.telemetry import (
+        MetricsServer, Telemetry, install, uninstall)
+
+    model, params = serving_model
+    plan = load_plan("serving_8dev_cpu_decode")
+    spec = MeshSpec(**{a: plan.mesh.get(a, 1)
+                       for a in ("pp", "dp", "fsdp", "sp", "tp")})
+    mesh = build_mesh(spec, jax.devices()[:spec.total])
+    tel = Telemetry(events_jsonl=str(tmp_path / "events.jsonl"))
+    install(tel)
+    try:
+        ms = MetricsServer(0, telemetry=tel)
+        assert ms.start() is not None
+        eng = Engine(model, place_params(params, mesh, plan),
+                     engine_config_for_plan(plan, spec_k=3),
+                     mesh=mesh)
+        for i in range(4):
+            eng.submit(Request(
+                id=f"g{i}",
+                prompt=np.asarray([1 + i, 2, 3], np.int32),
+                max_new_tokens=6))
+        eng.run_until_drained()
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{ms.port}/metrics",
+            timeout=10).read().decode()
+        for g in range(eng.dp_groups):
+            assert (f'dtt_serving_group_prefill_slots_active'
+                    f'{{group="{g}"}}' in body)
+        assert "\ndtt_serving_prefill_tokens_per_s " in "\n" + body
+        assert "\ndtt_serving_spec_accepted_mean " in "\n" + body
+        # Flat schema intact next to the new rows.
+        for gauge in SERVING_GAUGES:
+            assert f"\n{gauge} " in "\n" + body
+        ms.stop()
+    finally:
+        uninstall()
+        tel.close()
+
+
+def test_serving_prefill_audit_target_registered_and_pinned():
+    from distributed_training_tpu.analysis import targets
+
+    t = targets.TARGETS.get("serving_prefill_planned")
+    assert t is not None, ("serving prefill audit target missing — "
+                           "conf/plans/serving_4dev_cpu_prefill.json "
+                           "gone?")
+    assert t.kind == "serving"
+    assert t.serving_objective == "prefill"
+    assert "SPMD001" in t.pin_zero
+
+
+def test_serving_prefill_program_compiles_reshard_clean():
+    """The r03 acceptance pin, re-proved by compile: zero
+    involuntary reshards in the BATCHED prefill program under the
+    committed prefill plan."""
+    from distributed_training_tpu.analysis import audit, targets
+
+    rec = audit.audit_target(
+        targets.TARGETS["serving_prefill_planned"])
+    assert rec["spmd_reshard_warnings"] == 0
+    assert rec["findings_by_code"].get("SPMD001", 0) == 0
+
+
+def test_prefill_plan_objective_and_lane_feasibility():
+    """The committed prefill plan is resolved FOR the batched lane
+    program: slots deal over dp (slots%dp pinned infeasible), and
+    the winner's lane table spans the slice."""
+    from distributed_training_tpu.parallel.planner import (
+        Candidate, PLAN_TARGETS, load_plan, score_candidate)
+
+    plan = load_plan("serving_4dev_cpu_prefill")
+    assert plan.inputs.get("objective") == "prefill"
+    assert plan.batch_per_shard % plan.mesh.get("dp", 1) == 0
+    target = PLAN_TARGETS["serving_4dev_cpu_prefill"]
+    # A lane table that cannot deal over dp is infeasible by
+    # construction, not merely low-scoring.
+    bad = score_candidate(
+        target, Candidate(pp=1, dp=4, fsdp=1, sp=1, tp=1,
+                          remat="none", batch_per_shard=6))
+    assert bad["feasible"] is False and bad["reason"] == "slots%dp"
+    good = score_candidate(
+        target, Candidate(pp=1, dp=4, fsdp=1, sp=1, tp=1,
+                          remat="none", batch_per_shard=8))
+    assert good["feasible"] is True
+    # The prefill pool rides the feasibility model (the disagg
+    # handoff's source KV is real HBM).
+    assert good["kv_pool_gib"] > 0
+
+
+def test_serving_r03_ledger_committed_and_coherent():
+    """SERVING_r03.json: the batched-prefill and speculative-decode
+    acceptance gates stay machine-checked — >= 2x one-seq-per-launch
+    prefill same-run, spec decode above per-token launches same-run
+    with the mean acceptance length recorded, zero recompiles, and
+    greedy parity against the full-context reference."""
+    import os
+
+    root = os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))
+    with open(os.path.join(root, "SERVING_r03.json")) as f:
+        doc = json.load(f)
+    with open(os.path.join(root, "SERVING_r02.json")) as f:
+        r02 = json.load(f)
+    steady = doc["steady"]
+    assert steady["recompiles_after_warmup"] == 0
+    assert set(steady["compile_counts"]) == {"decode",
+                                             "prefill_batch"}
+    assert steady["greedy_matches_full_context"] is True
+    assert steady["spec_k"] > 1
+    # THE prefill acceptance number: aggregate prompt tok/s of the
+    # batched lane table >= 2x the r02-style one-seq-per-launch
+    # path measured on the same mesh in the same run.
+    pf = doc["prefill"]
+    assert pf["speedup_vs_sequential_same_run"] >= 2.0
+    assert pf["batched"]["prefill_tokens_per_s"] > \
+        pf["sequential_same_mesh"]["prefill_tokens_per_s"]
+    assert pf["batched"]["steps"] < \
+        pf["sequential_same_mesh"]["steps"]
+    assert pf["first_tokens_match_sequential"] is True
+    # THE decode acceptance number: speculative launches beat
+    # per-token launches same-run, acceptance recorded honestly.
+    sat = doc["saturated"]
+    assert sat["speedup_vs_per_token_same_run"] > 1.0
+    assert 1.0 <= sat["spec_accepted_mean"] <= sat["spec_k"]
+    assert sat["per_token_same_mesh"]["tokens_per_s"] > 0
+    cmp_block = doc["compared_to"]
+    assert cmp_block["revision"] == "r02"
+    assert cmp_block["tokens_per_s"] == \
+        r02["saturated"]["tokens_per_s"]
+    pre = doc["preemption"]
+    assert pre["tokens_match_steady_storm"] is True
+    assert 0 < pre["goodput"] <= 1
+    assert doc["streaming"]["ttft_first_byte_s"] > 0
+    assert doc["plan"]["mesh"]["dp"] > 1
+
+
 def test_serving_ledger_committed_and_coherent():
     """SERVING_r01.json: the acceptance criteria stay machine-checked
     (>= 20 concurrent, zero recompiles, a goodput figure for the
