@@ -51,6 +51,12 @@ Rule catalog (details in docs/static-analysis.md):
   as integers (data/stream.py), and hidden global RNG state is
   pipeline position that cannot, so resume silently replays or skips
   samples.
+- DTT010 host sync in serving hot paths: ``jax.device_get``,
+  ``block_until_ready``, ``np.asarray(device_value)`` anywhere in
+  ``serving/`` outside the designated sync helpers
+  (``Engine._fetch_host``, disagg's KV export/import) — the
+  device-resident decode loop's whole point is ONE host sync per
+  K-step burst, and a stray sync re-serializes the loop per token.
 """
 
 from __future__ import annotations
@@ -780,3 +786,73 @@ def _check_step_donation(ctx: FileContext):
                            f"jitted train step `{node.name}` without "
                            "donate_argnums/donate_argnames — params/"
                            "opt state double-buffer in HBM")
+
+
+# ---------------------------------------------------------------------------
+# DTT010 — host sync in serving hot paths
+# ---------------------------------------------------------------------------
+
+# Every module under serving/ is a hot path: the engine's step loop,
+# the KV pool, the scheduler, the HTTP front-end all sit between a
+# request and its tokens. The device-resident decode loop (SERVING_r04)
+# exists to sync the host ONCE per K-step burst; one stray
+# `device_get` in the wrong function silently re-serializes it back to
+# one sync per token. The ONLY functions allowed to materialize device
+# values on the host are the designated sync helpers below — every
+# other fetch must route through them (or carry `# noqa: DTT010` with
+# its justification, e.g. warmup/debug code off the steady-state path).
+DTT010_SCOPED = (
+    os.path.join("distributed_training_tpu", "serving"),
+)
+DTT010_SYNC_HELPERS: dict[str, set[str]] = {
+    os.path.join("distributed_training_tpu", "serving", "engine.py"):
+        {"_fetch_host"},
+    os.path.join("distributed_training_tpu", "serving", "disagg.py"):
+        {"export_kv_batch", "import_kv_batch"},
+}
+_DTT010_SYNC_CALLS = {"device_get", "block_until_ready"}
+
+
+@_rule("DTT010", "serving-hot-path-host-sync",
+       "host-device sync in serving/ outside a designated sync helper")
+def _check_serving_host_sync(ctx: FileContext):
+    """``jax.device_get`` / ``.block_until_ready()`` /
+    ``np.asarray(device_value)`` in ``serving/`` outside the
+    designated sync helpers (``Engine._fetch_host``, disagg's KV
+    export/import) forces an extra host round-trip per call site —
+    the resident decode loop's one-sync-per-burst contract dies one
+    innocent-looking fetch at a time. Host-side byte/list conversions
+    should use ``np.array`` (a copy, never a device sync);
+    ``jnp.asarray`` stays on device and stays legal."""
+    if not any(ctx.rel.startswith(p + os.sep) or ctx.rel == p
+               for p in DTT010_SCOPED):
+        return
+    allowed = DTT010_SYNC_HELPERS.get(ctx.rel, set())
+
+    def _enclosing_fn(node):
+        for anc in ctx.ancestors(node):
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return anc
+        return None
+
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _terminal_name(node.func)
+        if name in _DTT010_SYNC_CALLS:
+            pass
+        elif name == "asarray":
+            chain = _attr_chain(node.func)
+            if not chain or chain[0] not in ("np", "numpy"):
+                continue  # jnp.asarray / bare asarray: no host sync
+        else:
+            continue
+        fn = _enclosing_fn(node)
+        if fn is not None and fn.name in allowed:
+            continue
+        where = f"`{fn.name}`" if fn is not None else "module scope"
+        yield (node.lineno,
+               f"host sync `{name}(...)` in serving hot path {where} "
+               "— route fetches through the designated sync helper "
+               "(engine._fetch_host / disagg KV export-import); "
+               "host-side conversions use np.array")

@@ -74,10 +74,11 @@ class AuditTarget:
     # serving engine's compiled program under the committed plan
     # named by ``serving_plan`` (serving/disagg.py lowers it) —
     # ``serving_objective`` picks which engine program: "decode"
-    # (the whole-table one-token program) or "prefill" (the batched
-    # multi-sequence lane program, SERVING_r03). A KV-layout
-    # regression then goes tier-1 red with no accelerator, exactly
-    # like a train-step reshard.
+    # (the whole-table one-token program), "prefill" (the batched
+    # multi-sequence lane program, SERVING_r03) or "resident" (the
+    # device-resident K-step while_loop decode program,
+    # SERVING_r04). A KV-layout regression then goes tier-1 red
+    # with no accelerator, exactly like a train-step reshard.
     kind: str = "train"
     serving_plan: str = ""
     serving_objective: str = "decode"
@@ -272,6 +273,18 @@ _register_serving_target(
          "benchmarks/bench_serving.py measures for SERVING_r03. "
          "Zero SPMD001 pinned: the batched lane table must "
          "never compile into a replicating layout.",
+)
+_register_serving_target(
+    "serving_8dev_cpu_decode.json", "serving_resident_planned",
+    "resident", "serving device-resident K-step decode loop",
+    note="The committed serving decode plan compiled through the "
+         "engine's DEVICE-RESIDENT decode program (serving/engine.py "
+         "build_resident_decode_fn via serving/disagg.py) — the "
+         "lax.while_loop of speculative chunk steps "
+         "benchmarks/bench_serving.py measures for SERVING_r04. "
+         "Zero SPMD001 pinned: an in-loop page scatter or history "
+         "gather that starts replicating would multiply the reshard "
+         "cliff by K — it must fail tier-1 without a chip.",
 )
 
 

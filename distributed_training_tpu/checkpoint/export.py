@@ -93,7 +93,8 @@ def _plan_provenance(ckpt_dir: str, plan: str | None) -> dict | None:
 
 
 def export(ckpt_dir: str, out_path: str, step: int | None = None,
-           plan: str | None = None) -> dict:
+           plan: str | None = None,
+           quantize: str | None = None) -> dict:
     import jax
 
     # Site customizations may pin the platform at interpreter start,
@@ -102,6 +103,9 @@ def export(ckpt_dir: str, out_path: str, step: int | None = None,
     if os.environ.get("JAX_PLATFORMS"):
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 
+    if quantize not in (None, "int8"):
+        raise ValueError(
+            f"unsupported --quantize '{quantize}' (supported: int8)")
     ckpt_dir = os.path.abspath(ckpt_dir)
     state, step = restore_step_local(ckpt_dir, step)
 
@@ -115,12 +119,27 @@ def export(ckpt_dir: str, out_path: str, step: int | None = None,
     if prov is not None:
         meta["sharding_plan"] = prov
 
+    state = jax.tree.map(jax.device_get, state)
+    if quantize == "int8":
+        # Weight-only int8 serving artifact: the params subtree goes
+        # per-channel int8 (serving/disagg.py quantize_params_int8);
+        # the stamp is load-bearing — WeightStore validates it and
+        # the parity tests gate the layout against fp32 logits.
+        from distributed_training_tpu.serving.disagg import (
+            quantize_params_int8)
+        if "params" in state:
+            state = dict(state)
+            state["params"] = quantize_params_int8(state["params"])
+        else:
+            state = quantize_params_int8(state)
+        meta["quantization"] = "int8"
+
     from distributed_training_tpu.checkpoint.consolidate import (
         write_artifact,
     )
-    n = write_artifact(out_path,
-                       jax.tree.map(jax.device_get, state), meta)
-    return {"out": out_path, "step": int(step), "bytes": n}
+    n = write_artifact(out_path, state, meta)
+    return {"out": out_path, "step": int(step), "bytes": n,
+            "quantization": quantize or "none"}
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -134,9 +153,13 @@ def main(argv: list[str] | None = None) -> int:
                    help="sharding-plan provenance to stamp into the "
                         "artifact meta (default: auto-detect the "
                         "run's train.sharding_plan; 'none' to skip)")
+    p.add_argument("--quantize", default=None, choices=("int8",),
+                   help="weight-only quantization for the exported "
+                        "params (per-channel int8; stamped into the "
+                        "artifact meta for WeightStore validation)")
     args = p.parse_args(argv)
     print(json.dumps(export(args.ckpt, args.out, args.step,
-                            plan=args.plan)))
+                            plan=args.plan, quantize=args.quantize)))
     return 0
 
 

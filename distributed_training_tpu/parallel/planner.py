@@ -175,6 +175,12 @@ class PlanTarget:
     # against) and exclude sp/pp (the decode/prefill programs have
     # no sequence-parallel or pipelined form).
     objective: str = "train"
+    # Weight storage the serving objectives price params at: "none"
+    # (fp32) or "int8" (weight-only per-channel — serving/disagg.py
+    # quantize_params_int8; ~4× fewer attention/FFN param bytes per
+    # device, scales included). Feasibility-only: the compute model
+    # is unchanged (dequant-at-compute runs the same einsums).
+    quant: str = "none"
     note: str = ""
 
     def __post_init__(self):
@@ -182,6 +188,14 @@ class PlanTarget:
             raise PlanError(
                 f"unknown plan objective '{self.objective}' "
                 "(expected 'train', 'decode' or 'prefill')")
+        if self.quant not in ("none", "int8"):
+            raise PlanError(
+                f"unknown plan quant '{self.quant}' "
+                "(expected 'none' or 'int8')")
+        if self.quant != "none" and self.objective == "train":
+            raise PlanError(
+                "quant is a serving-objective knob (weight-only "
+                "int8 has no train-objective memory model)")
 
     def as_dict(self) -> dict:
         d = dataclasses.asdict(self)
@@ -190,6 +204,10 @@ class PlanTarget:
             # objective field; their recorded inputs must keep
             # matching this target's canonical form under --check.
             d.pop("objective")
+        if self.quant == "none":
+            # Same back-compat discipline for the quant field: every
+            # committed fp32 plan predates it.
+            d.pop("quant")
         return d
 
 
@@ -320,6 +338,31 @@ _register(PlanTarget(
          "is handed off onto this layout (serving/disagg.py) and "
          "decode continues there (speculative multi-token capable, "
          "SERVING_r03).",
+))
+
+_register(PlanTarget(
+    name="serving_8dev_cpu_decode_int8",
+    devices=8,
+    model_kwargs=dict(SERVING_MODEL_KWARGS),
+    seq_len=64,
+    optimizer="none",
+    chip="cpu",
+    # SAME budget as the fp32 decode target — the squeeze that made
+    # tp mandatory there. Weight-only int8 shrinks resident params
+    # ~4× (serving/disagg.py), so layouts fp32 priced out re-enter:
+    # the planner may now spend the freed bytes on dp instead of tp
+    # (dp is free aggregate throughput, tp pays all-reduces) — the
+    # int8 HBM credit changing the CHOSEN MESH is the planner-level
+    # proof the quantization matters, not just a smaller number.
+    hbm_gib=0.0005,
+    batch_candidates=(32,),
+    objective="decode",
+    quant="int8",
+    note="The serving_8dev_cpu_decode target served from an int8 "
+         "weight-only store (checkpoint/export.py --quantize int8): "
+         "same model, same budget, params priced at 1 byte/elem + "
+         "per-channel scales. SERVING_r04's quantized bench lane "
+         "lays the engine out with this plan.",
 ))
 
 
@@ -797,6 +840,26 @@ def _score_serving(target: PlanTarget, cand: Candidate,
     B_shard = cand.batch_per_shard
     D = cfg.d_model
     params_dev = n_params * pb / (cand.fsdp * cand.tp)
+    if target.quant == "int8":
+        # Weight-only int8 credit (serving/disagg.py _QUANT_AXES):
+        # the attention + FFN matmul weights store 1 byte/elem, their
+        # per-output-channel scales 4 bytes each, everything else
+        # (embeddings, norms, biases) stays at param-dtype bytes.
+        # Feasibility-only — layouts that replicated themselves out
+        # of budget at fp32 (dp-heavy, params unsharded) come back
+        # in, which is the whole point of serving int8.
+        hd = cfg.head_dim
+        q_elems = cfg.n_layers * (
+            2 * D * cfg.n_heads * hd          # wq + wo
+            + 2 * D * cfg.n_kv_heads * hd     # wk + wv
+            + 2 * D * cfg.d_ff)               # mlp wi + wo
+        s_elems = cfg.n_layers * (
+            cfg.n_heads * hd                  # wq scales
+            + 2 * cfg.n_kv_heads * hd         # wk + wv scales
+            + D                               # wo scales
+            + cfg.d_ff + D)                   # mlp wi + wo scales
+        params_dev = ((n_params - q_elems) * pb + q_elems
+                      + 4 * s_elems) / (cand.fsdp * cand.tp)
     kv_tok = 2 * cfg.n_layers * cfg.n_kv_heads * cfg.head_dim * ab
     budget = hbm_budget_gib(target) * 2**30
 
@@ -807,6 +870,8 @@ def _score_serving(target: PlanTarget, cand: Candidate,
         "batch_per_shard": B_shard,
         "hbm_budget_gib": round(hbm_budget_gib(target), 6),
     }
+    if target.quant != "none":
+        rec["quant"] = target.quant
     if target.objective == "decode":
         # Decode semantics (engine.py): the SLOT TABLE is BATCH-
         # SHARDED over dp — batch_per_shard is the AGGREGATE

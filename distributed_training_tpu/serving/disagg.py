@@ -51,16 +51,30 @@ logger = logging.getLogger(__name__)
 # ---------------------------------------------------------------------------
 
 
+def _is_quant_leaf(x) -> bool:
+    """An int8 weight-only leaf: ``{"qw": int8, "scale": f32}`` —
+    the dict IS the leaf for placement purposes (one sharding entry
+    in the plan covers both members)."""
+    return isinstance(x, dict) and "qw" in x and "scale" in x
+
+
 def plan_shardings(plan, mesh, params_tree):
     """Resolve ``plan.sharding_map`` (path → per-dim axis entries)
     into a pytree of NamedShardings matching ``params_tree``. Raises
     on a param path the plan does not name (same contract as
     PlannedStrategy: a model/plan mismatch fails at placement, not as
-    a silently replicated layout)."""
+    a silently replicated layout).
+
+    Int8 weight-only leaves (``{"qw", "scale"}`` dicts) resolve under
+    the SAME committed entries as their fp32 original: ``qw`` keeps
+    the weight's shape so it takes the plan's spec verbatim; the
+    keepdims ``scale`` replicates every REDUCED (size-1) dim and
+    inherits the spec on its kept output-channel dims — the quantized
+    layout is the committed layout, not a new one."""
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    def leaf(path, _leaf):
+    def leaf(path, lf):
         key = "/".join(str(getattr(k, "key", getattr(k, "idx", k)))
                        for k in path)
         try:
@@ -70,10 +84,19 @@ def plan_shardings(plan, mesh, params_tree):
                 f"plan '{plan.name}' names no sharding for param "
                 f"'{key}' — it was resolved against a different "
                 "model") from None
-        return NamedSharding(mesh, P(*[
-            tuple(e) if isinstance(e, list) else e for e in entries]))
 
-    return jax.tree_util.tree_map_with_path(leaf, params_tree)
+        def ns(ent):
+            return NamedSharding(mesh, P(*[
+                tuple(e) if isinstance(e, list) else e for e in ent]))
+
+        if _is_quant_leaf(lf):
+            scale_ent = [None if lf["scale"].shape[d] == 1 else e
+                         for d, e in enumerate(entries)]
+            return {"qw": ns(entries), "scale": ns(scale_ent)}
+        return ns(entries)
+
+    return jax.tree_util.tree_map_with_path(
+        leaf, params_tree, is_leaf=_is_quant_leaf)
 
 
 def place_params(params, mesh, plan):
@@ -82,6 +105,74 @@ def place_params(params, mesh, plan):
 
     shardings = plan_shardings(plan, mesh, params)
     return jax.tree.map(jax.device_put, params, shardings)
+
+
+# ---------------------------------------------------------------------------
+# Int8 weight-only quantization
+# ---------------------------------------------------------------------------
+
+# The quantizable weight sites (the serving transformer's matmul
+# operands) and the dims their per-OUTPUT-CHANNEL scale reduces over
+# — dim 0 is the stacked layer axis, always kept. Embeddings, the LM
+# head, norms and biases stay fp32: they are a rounding-error share
+# of the bytes and the head's logits precision is the parity gate.
+_QUANT_AXES: dict[tuple[str, str], tuple[int, ...]] = {
+    ("attn", "wq"): (1,),        # (L, D, H, hd)  — reduce D
+    ("attn", "wk"): (1,),        # (L, D, Hkv, hd)
+    ("attn", "wv"): (1,),        # (L, D, Hkv, hd)
+    ("attn", "wo"): (1, 2),      # (L, H, hd, D)  — reduce H, hd
+    ("mlp", "wi"): (1,),         # (L, D, F)      — reduce D
+    ("mlp", "wo"): (1,),         # (L, F, D)      — reduce F
+}
+
+
+def _quantize_leaf(w, axes: tuple[int, ...]) -> dict:
+    """Symmetric per-channel int8: ``qw * scale ≈ w`` with one f32
+    scale per output channel (keepdims — broadcast at dequant). An
+    all-zero channel keeps scale 1.0 (qw is 0 there anyway)."""
+    w = np.array(w, np.float32)
+    amax = np.max(np.abs(w), axis=axes, keepdims=True)
+    scale = np.where(amax > 0, amax / 127.0, 1.0).astype(np.float32)
+    qw = np.clip(np.round(w / scale), -127, 127).astype(np.int8)
+    return {"qw": qw, "scale": scale}
+
+
+def quantize_params_int8(params):
+    """The int8 weight-only layout of a serving params tree: every
+    ``_QUANT_AXES`` site becomes a ``{"qw": int8, "scale": f32}``
+    leaf (4× the bytes of the dominant weights back); everything
+    else passes through untouched. The engine's programs dequantize
+    AT COMPUTE through one helper (serving/engine.py ``_w``), so
+    fp32 and int8 stores run the same program bodies."""
+    out = dict(params)
+    for (grp, name), axes in _QUANT_AXES.items():
+        if grp not in out or name not in out[grp]:
+            continue
+        sub = dict(out[grp])
+        sub[name] = _quantize_leaf(sub[name], axes)
+        out[grp] = sub
+    return out
+
+
+def quantized_weight_bytes(params) -> dict:
+    """``{"fp32": bytes, "int8": bytes}`` for a (possibly already
+    quantized) params tree — the planner's HBM credit and the bench's
+    ``weight_bytes`` evidence share this arithmetic."""
+    import jax
+
+    fp32 = int8 = 0
+    for leaf in jax.tree.leaves(
+            params, is_leaf=_is_quant_leaf):
+        if _is_quant_leaf(leaf):
+            fp32 += 4 * int(np.prod(leaf["qw"].shape))
+            int8 += (leaf["qw"].size * leaf["qw"].dtype.itemsize
+                     + leaf["scale"].size
+                     * leaf["scale"].dtype.itemsize)
+        else:
+            n = int(np.prod(leaf.shape)) * leaf.dtype.itemsize
+            fp32 += n
+            int8 += n
+    return {"fp32": fp32, "int8": int8}
 
 
 # ---------------------------------------------------------------------------
@@ -119,6 +210,16 @@ class WeightStore:
         self.meta = meta
         self.state = state
         self.params = state["params"] if "params" in state else state
+        # Quantization provenance: the export CLI stamps the layout
+        # it wrote (checkpoint/export.py --quantize); an unknown
+        # stamp is refused rather than served as garbage weights.
+        self.quantization = str(
+            (meta or {}).get("quantization", "none"))
+        if self.quantization not in ("none", "int8"):
+            raise ValueError(
+                f"artifact {artifact_path} stamps unknown "
+                f"quantization '{self.quantization}' (supported: "
+                "none, int8)")
         if check_provenance:
             self._check_provenance()
 
@@ -286,7 +387,8 @@ def import_kv_batch(cache, items) -> None:
 def engine_config_for_plan(plan, page_size: int = 16,
                            prefill_chunk: int = 16,
                            prefill_mode: str = "batched",
-                           spec_k: int = 1) -> EngineConfig:
+                           spec_k: int = 1,
+                           resident_k: int = 1) -> EngineConfig:
     """The ONE engine geometry a plan implies — shared by the bench,
     the disagg pipeline, and the analysis audit targets so they all
     compile the same program shapes. ``batch_per_shard`` is the
@@ -315,6 +417,7 @@ def engine_config_for_plan(plan, page_size: int = 16,
         prefill_chunk=prefill_chunk,
         prefill_mode=prefill_mode,
         spec_k=spec_k,
+        resident_k=resident_k,
         kv_axis="tp",
         dp_axis="dp")
 
@@ -378,7 +481,7 @@ class DisaggPipeline:
                  req_id: str = "disagg") -> list[int]:
         from distributed_training_tpu.serving.engine import Request
 
-        prompt = np.asarray(prompt, np.int32)
+        prompt = np.array(prompt, np.int32)
         pe = self.prefill_engine
         req = Request(id=req_id, prompt=prompt,
                       max_new_tokens=max_new_tokens)
@@ -486,18 +589,46 @@ class DisaggPipeline:
 # ---------------------------------------------------------------------------
 
 
+def _quantize_struct(params_shapes):
+    """The int8 layout's ShapeDtypeStruct tree — the abstract twin of
+    ``quantize_params_int8`` (same sites, same keepdims scale shapes)
+    so plan verification compiles the program quantized stores
+    actually run."""
+    import jax
+    import jax.numpy as jnp
+
+    out = dict(params_shapes)
+    for (grp, name), axes in _QUANT_AXES.items():
+        if grp not in out or name not in out[grp]:
+            continue
+        sub = dict(out[grp])
+        s = sub[name]
+        sshape = tuple(1 if d in axes else n
+                       for d, n in enumerate(s.shape))
+        sub[name] = {
+            "qw": jax.ShapeDtypeStruct(s.shape, jnp.int8),
+            "scale": jax.ShapeDtypeStruct(sshape, jnp.float32)}
+        out[grp] = sub
+    return out
+
+
 def lower_serving_program(plan, objective: str):
     """Abstractly lower the engine's compiled program for ``plan``
     (objective "decode" → the dp-sharded group-batched decode
     program; "prefill" → the BATCHED multi-sequence prefill program,
-    the served path since SERVING_r03) on a fake CPU mesh with params
-    laid out per the plan. Returns ``(lowered, mesh)`` — no state
-    materialized (ShapeDtypeStruct inputs carrying the plan's
-    NamedShardings, analysis/compile.py's discipline). The program
-    itself comes from the SAME builders the engine compiles
-    (serving/engine.py ``build_decode_fn``/
-    ``build_prefill_batch_fn``), so the verified program and the
-    served program can never drift — shard_map over dp included."""
+    the served path since SERVING_r03; "resident" → the
+    DEVICE-RESIDENT K-step decode loop, SERVING_r04's served decode
+    path) on a fake CPU mesh with params laid out per the plan.
+    Returns ``(lowered, mesh)`` — no state materialized
+    (ShapeDtypeStruct inputs carrying the plan's NamedShardings,
+    analysis/compile.py's discipline). The program itself comes from
+    the SAME builders the engine compiles (serving/engine.py
+    ``build_decode_fn``/``build_prefill_batch_fn``/
+    ``build_resident_decode_fn``), so the verified program and the
+    served program can never drift — shard_map over dp included. A
+    plan carrying ``inputs["quant"] == "int8"`` lowers against the
+    quantized param structs, so the dequant-at-compute einsums are
+    in the verified HLO."""
     import dataclasses
 
     import jax
@@ -508,7 +639,7 @@ def lower_serving_program(plan, objective: str):
         model_for_plan)
     from distributed_training_tpu.runtime import fake_cpu_runtime
     from distributed_training_tpu.serving.engine import (
-        build_prefill_batch_fn)
+        build_prefill_batch_fn, build_resident_decode_fn)
 
     jax.config.update("jax_platforms", "cpu")
     model = model_for_plan(plan)
@@ -516,10 +647,16 @@ def lower_serving_program(plan, objective: str):
                           **{a: s for a, s in plan.mesh.items()
                              if s > 1})
     mesh = rt.mesh
-    ecfg = dataclasses.replace(engine_config_for_plan(plan),
-                               paged_impl="ref")
+    resident = objective == "resident"
+    ecfg = dataclasses.replace(
+        engine_config_for_plan(
+            plan, spec_k=4 if resident else 1,
+            resident_k=4 if resident else 1),
+        paged_impl="ref")
     c = model.cfg
     params_shapes = jax.eval_shape(model.init, jax.random.PRNGKey(0))
+    if plan.inputs.get("quant", "none") == "int8":
+        params_shapes = _quantize_struct(params_shapes)
     shardings = plan_shardings(plan, mesh, params_shapes)
     params = jax.tree.map(
         lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype,
@@ -548,6 +685,17 @@ def lower_serving_program(plan, objective: str):
                 arr((G, B, Ppages), jnp.int32, grp),
                 arr((G, B), jnp.bool_, grp),
                 arr((G, 2), jnp.uint32, grp))
+    elif objective == "resident":
+        # The device-resident burst program at the r04 bench shape
+        # (resident_k=4, spec_k=4) — page rows, history, cursors and
+        # stop flags all group-batched; no rng (greedy by contract).
+        fn = build_resident_decode_fn(c, ecfg, mesh=mesh)
+        args = (params, pool, pool,
+                arr((G, B, Ppages), jnp.int32, grp),
+                arr((G, B, ecfg.max_seq_len), jnp.int32, grp),
+                arr((G, B), jnp.int32, grp),
+                arr((G, B), jnp.int32, grp),
+                arr((G, B), jnp.bool_, grp))
     else:
         # The batched prefill lane table: the plan's slot count dealt
         # over dp, prefill_chunk tokens per lane — exactly the
@@ -592,6 +740,7 @@ def compile_verify_serving(target, plan) -> dict:
         "reshard_ops": sorted({w["op"] for w in warnings}),
         "collective_bytes_per_step": coll["bytes_per_step"],
         "total_collectives": coll["total_collectives"],
-        "program": ("decode" if target.objective == "decode"
-                    else "prefill_batch"),
+        "program": {"decode": "decode",
+                    "resident": "resident"}.get(target.objective,
+                                                "prefill_batch"),
     }

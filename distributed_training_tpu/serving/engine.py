@@ -124,6 +124,8 @@ class EngineConfig:
     prefill_mode: str = "batched"  # "batched" | "sequential" (r02 path)
     spec_k: int = 1               # decode tokens per launch (1 = off)
     spec_ngram: int = 3           # longest prompt-lookup n-gram tried
+    resident_k: int = 1           # device-resident decode steps (1 = off)
+    eos_id: int = -1              # stop token (< 0 = disabled)
     policy: str = "prefill"       # "prefill" | "decode" priority
     temperature: float = 0.0
     top_k: int = 0
@@ -157,6 +159,19 @@ class EngineConfig:
                 "temperature == 0 — the verification accepts exactly "
                 "the argmax chain, which has no sampled analogue "
                 "without rejection sampling")
+        if self.resident_k < 1:
+            raise ValueError("resident_k must be >= 1")
+        if self.resident_k > 1 and self.temperature > 0:
+            raise ValueError(
+                "device-resident decode (resident_k > 1) requires "
+                "greedy temperature == 0 — the in-program accept/"
+                "stop logic is exact only for the argmax chain")
+        if self.resident_k > 1 and self.prefill_mode != "batched":
+            raise ValueError(
+                "device-resident decode (resident_k > 1) requires "
+                "prefill_mode='batched' — the sequential r02 prefill "
+                "pulls a logits block per chunk, defeating the "
+                "burst's one-sync contract")
 
 
 @dataclass
@@ -177,6 +192,8 @@ class _Seq:
     generated: list = field(default_factory=list)
     first_token_t: float | None = None
     token_times: list = field(default_factory=list)
+    eos: bool = False             # emitted the configured stop token
+    ngram: "NgramIndex | None" = None  # lazy prompt-lookup index
 
     @property
     def prompt_len(self) -> int:
@@ -188,7 +205,8 @@ class _Seq:
 
     @property
     def done(self) -> bool:
-        return len(self.generated) >= self.req.max_new_tokens
+        return self.eos or \
+            len(self.generated) >= self.req.max_new_tokens
 
 
 def _rope_bhd(x, positions):
@@ -225,6 +243,20 @@ def _layer_norm(x, scale, bias):
     return (y * scale + bias).astype(dtype)
 
 
+def _w(leaf, dt):
+    """A weight leaf in compute dtype. An int8 weight-only leaf is a
+    dict ``{"qw": int8, "scale": fp32}`` with per-output-channel
+    scales (serving/disagg.py ``quantize_params_int8``) and is
+    DEQUANTIZED AT COMPUTE — the stored layout (and its tp/fsdp
+    partition specs) stays int8; plain arrays cast exactly as
+    before. Every weight einsum in the serving programs reads its
+    operand through this one helper so the fp32 and int8 paths
+    cannot drift."""
+    if isinstance(leaf, dict):
+        return leaf["qw"].astype(dt) * leaf["scale"].astype(dt)
+    return leaf.astype(dt)
+
+
 def draft_tokens(history: np.ndarray, m: int,
                  ngram_max: int = 3) -> np.ndarray:
     """Prompt-lookup drafting: ``m`` speculative tokens from the
@@ -237,7 +269,7 @@ def draft_tokens(history: np.ndarray, m: int,
     token repeated. Draft quality only moves the ACCEPTANCE LENGTH —
     never the output: verification emits exactly the argmax chain
     regardless (serving/engine.py spec decode)."""
-    hist = np.asarray(history, np.int32)
+    hist = np.array(history, np.int32)
     L = hist.shape[0]
     if m <= 0 or L == 0:
         return np.zeros((max(0, m),), np.int32)
@@ -259,6 +291,71 @@ def draft_tokens(history: np.ndarray, m: int,
                                   np.int32)])
             return cont.astype(np.int32)
     return np.full((m,), fill, np.int32)
+
+
+class NgramIndex:
+    """Incremental trailing-n-gram index behind ``Engine._draft``.
+
+    ``draft_tokens`` re-scans the sequence's FULL history with a
+    sliding-window numpy pass per launch — O(L · ngram) per slot per
+    launch, the dominant host cost of a long sequence's speculative
+    step. This keeps, per n <= ngram_max, a dict from n-gram tuple to
+    its MOST RECENT start plus a per-start link to the previous start
+    of the same gram, updated in O(ngram) per appended token — so a
+    draft is a dict probe, not a rescan. Drafts are pinned IDENTICAL
+    to ``draft_tokens`` by a randomized test (draft quality only
+    moves acceptance length, but the pin keeps the ledgers
+    comparable across revisions)."""
+
+    def __init__(self, ngram_max: int = 3):
+        self.ngram_max = ngram_max
+        self.hist: list[int] = []
+        # maps[n-1]: gram tuple -> most recent start index;
+        # prev[n-1]: start index -> previous start of the same gram.
+        self._maps: list[dict] = [{} for _ in range(ngram_max)]
+        self._prev: list[dict] = [{} for _ in range(ngram_max)]
+
+    def __len__(self) -> int:
+        return len(self.hist)
+
+    def extend(self, tokens) -> None:
+        for t in tokens:
+            self.append(int(t))
+
+    def append(self, t: int) -> None:
+        self.hist.append(int(t))
+        L = len(self.hist)
+        for n in range(1, self.ngram_max + 1):
+            if L < n:
+                break
+            start = L - n
+            gram = tuple(self.hist[start:])
+            m = self._maps[n - 1]
+            if gram in m:
+                self._prev[n - 1][start] = m[gram]
+            m[gram] = start
+
+    def draft(self, m: int) -> np.ndarray:
+        """``m`` drafted tokens — same contract (and pinned same
+        output) as ``draft_tokens(hist, m, ngram_max)``."""
+        L = len(self.hist)
+        if m <= 0 or L == 0:
+            return np.zeros((max(0, m),), np.int32)
+        fill = self.hist[-1]
+        for n in range(min(self.ngram_max, L - 1), 0, -1):
+            pat = tuple(self.hist[L - n:])
+            p = self._maps[n - 1].get(pat)
+            if p == L - n:
+                # The trailing gram itself — an occurrence needs a
+                # continuation token, so step to the previous start
+                # (draft_tokens' windows stop at L - n).
+                p = self._prev[n - 1].get(p)
+            if p is None:
+                continue
+            cont = self.hist[p + n:p + n + m]
+            return np.array(cont + [fill] * (m - len(cont)),
+                            np.int32)
+        return np.full((m,), fill, np.int32)
 
 
 # ---------------------------------------------------------------------------
@@ -417,6 +514,40 @@ def build_spec_decode_fn(model_cfg, ecfg: EngineConfig, mesh=None):
     return _chunk_fn(model_cfg, ecfg, emit="all", mesh=mesh)
 
 
+def build_resident_decode_fn(model_cfg, ecfg: EngineConfig,
+                             mesh=None):
+    """The jitted DEVICE-RESIDENT decode program: a
+    ``lax.while_loop`` of up to ``resident_k`` chunk iterations
+    (each one a ``spec_k``-wide speculative step — the same
+    ``_chunk_hidden`` math as the host-driven paths), drafting,
+    verifying, stop-detecting (EOS / budget) and advancing each
+    slot's page cursor IN-PROGRAM. The host syncs once per burst.
+
+    Signature (all group-batched, G = dp extent, B = group-local
+    slots, Lmax = max_seq_len, T = resident_k * spec_k):
+    ``fn(params, k_pages, v_pages, page_rows (G, B, P), history
+    (G, B, Lmax), kv_len (G, B), budget (G, B), active (G, B)) ->
+    (out (G, B, T), n_emitted (G, B), steps (G,), k_pages,
+    v_pages)``. Pools are donated. An all-slots-complete burst
+    returns early via the loop predicate."""
+    import functools
+
+    import jax
+
+    body = functools.partial(
+        _resident_program, cfg=model_cfg, K=ecfg.resident_k,
+        C=ecfg.spec_k, ngram=ecfg.spec_ngram, eos_id=ecfg.eos_id,
+        paged_impl=ecfg.paged_impl)
+    kw = {}
+    if mesh is not None:
+        grp, pool = _out_shardings(model_cfg, ecfg, mesh)
+        kw["out_shardings"] = (grp, grp, grp, pool, pool)
+    if _dp_extent(mesh, ecfg.dp_axis) > 1:
+        body = _sharded(body, mesh, ecfg.dp_axis,
+                        n_grouped=7, n_replicated=0, n_outs=5)
+    return jax.jit(body, donate_argnums=(1, 2), **kw)
+
+
 class Engine:
     """The continuous-batching engine over one model + weight set.
 
@@ -466,6 +597,20 @@ class Engine:
         self.spec_stats = {"launches": 0, "emitted": 0}
         self._step_spec: tuple[int, int] | None = None
         self._last_prefill_lanes: list[int] | None = None
+        # Device-resident decode accounting: program launches and
+        # total in-program loop iterations (the burst depth the
+        # ``dtt_serving_resident_steps_per_launch`` gauge tracks).
+        self.resident_stats = {"launches": 0, "steps": 0,
+                               "emitted": 0}
+        self._step_resident: tuple[float, int] | None = None
+        # EVERY device->host sync in the serving hot path goes
+        # through ``_fetch_host`` (pitfalls rule DTT010), so this
+        # counter is exact — the bench asserts syncs <= tokens /
+        # resident_k + completions.
+        self.host_syncs = 0
+        self.weight_bytes = int(sum(
+            getattr(x, "nbytes", 0)
+            for x in jax.tree.leaves(params)))
         self.cache = PagedKVCache(
             PagedCacheConfig(
                 n_layers=model.cfg.n_layers,
@@ -496,7 +641,15 @@ class Engine:
 
     def _build_programs(self) -> None:
         c = self.model.cfg
-        if self.cfg.spec_k > 1:
+        if self.cfg.resident_k > 1:
+            # The device-resident K-step loop IS the decode program:
+            # each loop iteration is one spec_k-wide chunk (spec_k=1
+            # degenerates to plain one-token steps), so speculation
+            # composes inside the burst. One jit entry, one sync per
+            # burst.
+            self._decode_fn = build_resident_decode_fn(
+                c, self.cfg, self.mesh)
+        elif self.cfg.spec_k > 1:
             # Multi-token decode IS the chunk program at C = spec_k
             # (even an effective one-token launch — pages tight, or
             # one token remaining — rides it with n_valid = 1: one
@@ -539,7 +692,18 @@ class Engine:
         P = self.cache.cfg.pages_per_seq
         C = self.cfg.prefill_chunk
         rng = jnp.zeros((G, 2), jnp.uint32)
-        if self.cfg.spec_k > 1:
+        if self.cfg.resident_k > 1:
+            # All-dead burst: zero budgets fail the loop predicate at
+            # iteration 0 (the all-slots-complete early exit), but
+            # tracing still compiles the full resident body.
+            _o, _n, _s, k, v = self._decode_fn(
+                self.params, self.cache.k_pages, self.cache.v_pages,
+                jnp.zeros((G, B, P), jnp.int32),
+                jnp.zeros((G, B, self.cfg.max_seq_len), jnp.int32),
+                jnp.zeros((G, B), jnp.int32),
+                jnp.zeros((G, B), jnp.int32),
+                jnp.zeros((G, B), jnp.bool_))
+        elif self.cfg.spec_k > 1:
             _t, k, v = self._decode_fn(
                 self.params, self.cache.k_pages, self.cache.v_pages,
                 jnp.zeros((G, B, P), jnp.int32),
@@ -717,7 +881,9 @@ class Engine:
                 "prefill" if want_prefill else "idle")
         tokens_out = 0
         self._step_spec = None
+        self._step_resident = None
         self._last_prefill_lanes = None
+        syncs0 = self.host_syncs
         if kind == "prefill":
             if self.cfg.prefill_mode == "batched":
                 # Admit everything slots+pages allow BEFORE the
@@ -761,6 +927,16 @@ class Engine:
             launches, emitted = self._step_spec
             rec["spec_k"] = self.cfg.spec_k
             rec["spec_accepted_mean"] = round(emitted / launches, 4)
+        if self._step_resident is not None:
+            mean_steps, _slots = self._step_resident
+            rec["resident_k"] = self.cfg.resident_k
+            rec["resident_steps_per_launch"] = mean_steps
+        syncs = self.host_syncs - syncs0
+        rec["host_syncs"] = syncs
+        if tokens_out:
+            rec["host_syncs_per_token"] = round(
+                syncs / tokens_out, 6)
+        rec["weight_bytes"] = self.weight_bytes
         if self.dp_groups > 1:
             rec["group_slots_active"] = self.slots_active_by_group()
             if self._last_prefill_lanes is not None:
@@ -769,6 +945,16 @@ class Engine:
         event("serving", **rec)
         self._step_counter += 1
         return rec
+
+    def _fetch_host(self, *arrays) -> tuple:
+        """THE designated device->host sync point of the serving hot
+        path: every blocking fetch in the step loop funnels through
+        here so the sync cadence is countable (``host_syncs``, the
+        ``dtt_serving_host_syncs_per_token`` gauge) and so pitfalls
+        rule DTT010 can flag any round-trip that creeps in anywhere
+        else. One call = one sync, however many arrays ride it."""
+        self.host_syncs += 1
+        return tuple(np.asarray(a) for a in arrays)
 
     def _group_row(self, seq_id) -> tuple[np.ndarray, np.ndarray, int]:
         """(G, P) page rows + (G,) live mask for a single sequence:
@@ -814,11 +1000,14 @@ class Engine:
             # not scale with vocab x dp). The batched prefill path
             # goes further and never moves logits at all (in-program
             # sampling).
-            tok = self._sample_host(np.asarray(logits[g]))
+            (lg,) = self._fetch_host(logits[g])
+            tok = self._sample_host(lg)
             now = time.monotonic()
             seq.first_token_t = now
             seq.token_times.append(now)
             seq.generated.append(tok)
+            if self.cfg.eos_id >= 0 and tok == self.cfg.eos_id:
+                seq.eos = True
             self._emit_token(seq, tok)
             self._maybe_finish(seq)
         return True
@@ -826,7 +1015,8 @@ class Engine:
     def _sample_host(self, logits) -> int:
         """Sample the prefill's first token on host — one token per
         request lifetime; the decode program samples the rest
-        in-compiled."""
+        in-compiled. ``logits`` is a HOST array (the caller already
+        pulled it through ``_fetch_host``)."""
         import jax
         import jax.numpy as jnp
 
@@ -834,7 +1024,7 @@ class Engine:
             # Host argmax: one V-sized transfer instead of a device
             # argmax dispatch + sync — on the dispatch-bound CPU
             # mesh the extra launch was ~30% of a prefill step.
-            return int(np.asarray(logits).argmax())
+            return int(logits.argmax())
         rng = jax.random.fold_in(self._base_rng,
                                  1_000_000 + self._step_counter)
         lg = logits / self.cfg.temperature
@@ -854,9 +1044,9 @@ class Engine:
             return self._zero_rng
         base = jax.random.fold_in(self._base_rng, salt)
         return jnp.asarray(np.stack([
-            np.asarray(jax.random.key_data(
-                jax.random.fold_in(base, g)))
-            for g in range(self.dp_groups)]))
+            np.asarray(jax.random.key_data(  # noqa: DTT010 — sampled
+                jax.random.fold_in(base, g)))  # path only; greedy
+            for g in range(self.dp_groups)]))  # rides _zero_rng
 
     def _run_prefill_batch(self, pending: list[_Seq]) -> int:
         """One launch of the batched prefill program: pack up to
@@ -922,25 +1112,37 @@ class Engine:
                         # fetch: under async dispatch an earlier
                         # clock read would exclude the launch's own
                         # compute from TTFT.
-                        fetched = np.asarray(nxt)
+                        (fetched,) = self._fetch_host(nxt)
                         now = time.monotonic()
                     tok = int(fetched[g, i])
                     s.first_token_t = now
                     s.token_times.append(now)
                     s.generated.append(tok)
+                    if self.cfg.eos_id >= 0 and \
+                            tok == self.cfg.eos_id:
+                        s.eos = True
                     self._emit_token(s, tok)
                     self._maybe_finish(s)
         return total
 
     def _draft(self, seq: _Seq, m: int) -> np.ndarray:
         """``m`` drafted tokens for ``seq`` by prompt lookup over its
-        own history (prompt + generated) — see ``draft_tokens``."""
+        own history (prompt + generated) — ``draft_tokens``
+        semantics served from the sequence's INCREMENTAL
+        ``NgramIndex`` (built lazily on first draft, extended by the
+        tokens emitted since the last one — O(new tokens), not a
+        full-history rescan per launch)."""
         if m <= 0:
             return np.zeros((0,), np.int32)
-        hist = np.concatenate([
-            np.asarray(seq.req.prompt, np.int32),
-            np.asarray(seq.generated, np.int32)])
-        return draft_tokens(hist, m, self.cfg.spec_ngram)
+        idx = seq.ngram
+        if idx is None:
+            idx = seq.ngram = NgramIndex(self.cfg.spec_ngram)
+            idx.extend(seq.req.prompt.tolist())
+            idx.extend(seq.generated)
+        else:
+            idx.extend(
+                seq.generated[len(idx) - seq.prompt_len:])
+        return idx.draft(m)
 
     def _run_decode_spec(self, decodable: list[_Seq]) -> int:
         """One launch of the speculative multi-token decode program:
@@ -996,7 +1198,7 @@ class Engine:
             jnp.asarray(start_pos), jnp.asarray(n_valid),
             jnp.asarray(active), self._zero_rng)
         self.cache.update_pools(k, v)
-        out = np.asarray(out)
+        (out,) = self._fetch_host(out)
         now = time.monotonic()
         total = 0
         for s, n, draft in stepped:
@@ -1010,11 +1212,18 @@ class Engine:
             while j < n and int(draft[j - 1]) == emit[-1]:
                 emit.append(int(out[g, i, j]))
                 j += 1
+            if self.cfg.eos_id >= 0 and self.cfg.eos_id in emit:
+                # Stop at the stop token: later accepted positions
+                # are conditioned on a sequence that already ended.
+                emit = emit[:emit.index(self.cfg.eos_id) + 1]
             self.cache.advance(s.req.id, len(emit))
             self.spec_stats["launches"] += 1
             self.spec_stats["emitted"] += len(emit)
             for tok in emit:
                 s.generated.append(tok)
+                if self.cfg.eos_id >= 0 and \
+                        tok == self.cfg.eos_id:
+                    s.eos = True
                 if s.first_token_t is None:
                     s.first_token_t = now
                 s.token_times.append(now)
@@ -1024,9 +1233,97 @@ class Engine:
         self._step_spec = (len(stepped), total)
         return total
 
+    def _run_decode_resident(self, decodable: list[_Seq]) -> int:
+        """One BURST of the device-resident decode loop: every
+        decodable slot ships its full history row + a token budget,
+        the program runs up to ``resident_k`` chunk iterations
+        (drafting, verifying, stop-detecting and advancing its own
+        page cursor per slot ON DEVICE), and the host syncs ONCE for
+        the whole burst — ``(out, n_emitted, steps)``, one
+        ``_fetch_host`` call. Greedy token identity is preserved by
+        construction: each iteration emits exactly the argmax chain
+        the host spec path would (the same ``_chunk_hidden`` math),
+        so K only moves the sync cadence, never tokens. A burst is
+        atomic host-side — the cache advances only after the fetch —
+        so a preemption between bursts resubmits cleanly."""
+        import jax.numpy as jnp
+
+        G, B = self.dp_groups, self.batch_local
+        T = self.cfg.resident_k * self.cfg.spec_k
+        L = self.cfg.max_seq_len
+        history = np.zeros((G, B, L), np.int32)
+        kv_len = np.zeros((G, B), np.int32)
+        budget = np.zeros((G, B), np.int32)
+        active = np.zeros((G, B), bool)
+        seq_ids: list[list] = [[None] * B for _ in range(G)]
+        stepped: list[_Seq] = []
+        for s in decodable:
+            length = self.cache.length(s.req.id)
+            remaining = s.req.max_new_tokens - len(s.generated)
+            # The burst budget is clamped to the pages the slot
+            # could actually claim RIGHT NOW (its allocated pages +
+            # its group's free list): a tight pool degrades the
+            # burst toward one token — the all-slots-stall
+            # fallback — instead of stalling the slot outright.
+            cap = self.cache.token_capacity(s.req.id)
+            want = min(remaining, T, cap - length)
+            if want < 1:
+                continue  # zero headroom: wait for frees
+            if not self.cache.ensure(s.req.id, length + want):
+                continue
+            g, i = divmod(s.slot, B)
+            hist = np.concatenate([
+                np.array(s.req.prompt, np.int32),
+                np.array(s.generated, np.int32)])
+            history[g, i, :hist.shape[0]] = hist
+            kv_len[g, i] = length
+            budget[g, i] = want
+            active[g, i] = True
+            seq_ids[g][i] = s.req.id
+            stepped.append(s)
+        if not stepped:
+            return 0
+        rows = self.cache.page_rows_grouped(seq_ids)
+        out, n_emitted, steps, k, v = self._decode_fn(
+            self.params, self.cache.k_pages, self.cache.v_pages,
+            jnp.asarray(rows), jnp.asarray(history),
+            jnp.asarray(kv_len), jnp.asarray(budget),
+            jnp.asarray(active))
+        self.cache.update_pools(k, v)
+        out, n_emitted, steps = self._fetch_host(
+            out, n_emitted, steps)
+        now = time.monotonic()
+        total = 0
+        for s in stepped:
+            g, i = divmod(s.slot, B)
+            e = int(n_emitted[g, i])
+            self.cache.advance(s.req.id, e)
+            for t in range(e):
+                tok = int(out[g, i, t])
+                s.generated.append(tok)
+                if self.cfg.eos_id >= 0 and \
+                        tok == self.cfg.eos_id:
+                    s.eos = True
+                if s.first_token_t is None:
+                    s.first_token_t = now
+                s.token_times.append(now)
+                self._emit_token(s, tok)
+            total += e
+            self._maybe_finish(s)
+        g_steps = [int(steps[g]) for g in range(G)
+                   if active[g].any()]
+        mean_steps = sum(g_steps) / max(1, len(g_steps))
+        self.resident_stats["launches"] += 1
+        self.resident_stats["steps"] += max(g_steps, default=0)
+        self.resident_stats["emitted"] += total
+        self._step_resident = (round(mean_steps, 4), len(stepped))
+        return total
+
     def _run_decode(self, decodable: list[_Seq]) -> int:
         import jax.numpy as jnp
 
+        if self.cfg.resident_k > 1:
+            return self._run_decode_resident(decodable)
         if self.cfg.spec_k > 1:
             return self._run_decode_spec(decodable)
         G, B = self.dp_groups, self.batch_local
@@ -1058,13 +1355,15 @@ class Engine:
             jnp.asarray(tokens), jnp.asarray(positions),
             jnp.asarray(rows), jnp.asarray(active), rng)
         self.cache.update_pools(k, v)
-        nxt = np.asarray(nxt)
+        (nxt,) = self._fetch_host(nxt)
         now = time.monotonic()
         for s in stepped:
             g, i = divmod(s.slot, B)
             self.cache.advance(s.req.id, 1)
             tok = int(nxt[g, i])
             s.generated.append(tok)
+            if self.cfg.eos_id >= 0 and tok == self.cfg.eos_id:
+                s.eos = True
             if s.first_token_t is None:
                 s.first_token_t = now
             s.token_times.append(now)
@@ -1120,7 +1419,7 @@ class Engine:
         (the generate-CLI route). Returns the generated token ids."""
         rid = f"gen-{self._step_counter}-{len(self.completed)}"
         self.submit(Request(id=rid,
-                            prompt=np.asarray(prompt, np.int32),
+                            prompt=np.array(prompt, np.int32),
                             max_new_tokens=max_new_tokens))
         self.run_until_drained()
         rec = next(r for r in reversed(self.completed)
@@ -1186,6 +1485,9 @@ class Engine:
             seq.first_token_t = now
             seq.token_times.append(now)
             seq.generated.append(int(first_token))
+            if self.cfg.eos_id >= 0 and \
+                    int(first_token) == self.cfg.eos_id:
+                seq.eos = True
             self._emit_token(seq, int(first_token))
             self._maybe_finish(seq)
 
@@ -1284,11 +1586,11 @@ def _decode_program(params, k_pages, v_pages, tokens, positions,
         h = _layer_norm(x, layer["ln1"]["scale"],
                         layer["ln1"]["bias"])
         q = jnp.einsum("bd,dhk->bhk", h,
-                       layer["attn"]["wq"].astype(dt))
+                       _w(layer["attn"]["wq"], dt))
         k = jnp.einsum("bd,dhk->bhk", h,
-                       layer["attn"]["wk"].astype(dt))
+                       _w(layer["attn"]["wk"], dt))
         v = jnp.einsum("bd,dhk->bhk", h,
-                       layer["attn"]["wv"].astype(dt))
+                       _w(layer["attn"]["wv"], dt))
         if cfg.pos_encoding == "rope":
             q = _rope_bhd(q, positions)
             k = _rope_bhd(k, positions)
@@ -1297,14 +1599,14 @@ def _decode_program(params, k_pages, v_pages, tokens, positions,
         attn = paged_attention(q, kp, vp, lengths, page_tables,
                                impl=paged_impl)
         x = x + jnp.einsum("bhk,hkd->bd", attn,
-                           layer["attn"]["wo"].astype(dt))
+                           _w(layer["attn"]["wo"], dt))
         h = _layer_norm(x, layer["ln2"]["scale"],
                         layer["ln2"]["bias"])
         m = layer["mlp"]
         u = jax.nn.gelu(jnp.einsum("bd,df->bf", h,
-                                   m["wi"].astype(dt))
+                                   _w(m["wi"], dt))
                         + m["bi"].astype(dt))
-        x = x + (jnp.einsum("bf,fd->bd", u, m["wo"].astype(dt))
+        x = x + (jnp.einsum("bf,fd->bd", u, _w(m["wo"], dt))
                  + m["bo"].astype(dt))
         return x, (kp, vp)
 
@@ -1390,11 +1692,11 @@ def _prefill_program(params, k_pages, v_pages, page_row, live,
         h = _layer_norm(x, layer["ln1"]["scale"],
                         layer["ln1"]["bias"])
         q = jnp.einsum("cd,dhk->chk", h,
-                       layer["attn"]["wq"].astype(dt))
+                       _w(layer["attn"]["wq"], dt))
         k = jnp.einsum("cd,dhk->chk", h,
-                       layer["attn"]["wk"].astype(dt))
+                       _w(layer["attn"]["wk"], dt))
         v = jnp.einsum("cd,dhk->chk", h,
-                       layer["attn"]["wv"].astype(dt))
+                       _w(layer["attn"]["wv"], dt))
         if cfg.pos_encoding == "rope":
             q = _rope_bhd(q, abs_pos)
             k = _rope_bhd(k, abs_pos)
@@ -1411,14 +1713,14 @@ def _prefill_program(params, k_pages, v_pages, page_row, live,
             attn = paged_attention_chunk(
                 q[None], kp, vp, page_row[None], q_pos)[0]
         x = x + jnp.einsum("chk,hkd->cd", attn,
-                           layer["attn"]["wo"].astype(dt))
+                           _w(layer["attn"]["wo"], dt))
         h = _layer_norm(x, layer["ln2"]["scale"],
                         layer["ln2"]["bias"])
         m = layer["mlp"]
         u = jax.nn.gelu(jnp.einsum("cd,df->cf", h,
-                                   m["wi"].astype(dt))
+                                   _w(m["wi"], dt))
                         + m["bi"].astype(dt))
-        x = x + (jnp.einsum("cf,fd->cd", u, m["wo"].astype(dt))
+        x = x + (jnp.einsum("cf,fd->cd", u, _w(m["wo"], dt))
                  + m["bo"].astype(dt))
         return x, (kp, vp)
 
@@ -1433,6 +1735,104 @@ def _prefill_program(params, k_pages, v_pages, page_row, live,
     logits = jnp.einsum("d,dv->v", x_last,
                         head.astype(dt)).astype(jnp.float32)
     return logits[None], k_pages_g[None], v_pages_g[None]
+
+
+def _chunk_hidden(params, k_pages_g, v_pages_g, page_rows, tokens,
+                  start_pos, n_valid, active, *, cfg):
+    """The multi-lane chunk forward SHARED by ``_chunk_program``
+    (batched prefill + speculative verification) and
+    ``_resident_program`` (every resident loop iteration) — ONE
+    implementation, so the device-resident path cannot drift from
+    the host-verified chunk math. Operates on one group's UNPACKED
+    block (no leading group dim): k_pages_g/v_pages_g
+    (L, Hkv, N, ps, hd); page_rows (S, P); tokens (S, C); start_pos,
+    n_valid (S,); active (S,) bool. Writes every lane's valid
+    tokens' KV through one batched page-row scatter and returns
+    ``(x (S, C, D) final hidden states, valid (S, C), k_pages_g,
+    v_pages_g)``."""
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_training_tpu.ops.paged_attention import (
+        paged_attention_chunk)
+
+    dt = jnp.dtype(cfg.dtype)
+    S, C = tokens.shape
+    P = page_rows.shape[1]
+    ps = k_pages_g.shape[3]
+    idx = jnp.arange(C, dtype=jnp.int32)
+    abs_pos = start_pos[:, None] + idx[None, :]           # (S, C)
+    valid = (idx[None, :] < n_valid[:, None]) & active[:, None]
+    x = params["tok_embed"][tokens].astype(dt)            # (S, C, D)
+    if cfg.pos_encoding == "learned":
+        safe = jnp.minimum(abs_pos, cfg.max_seq_len - 1)
+        x = x + params["pos_embed"][safe].astype(dt)
+    # Page coordinates per (lane, position); dead writes → each
+    # group's scratch page 0 (page index clamped first: padding
+    # positions of a lane near max_seq_len could index past its row).
+    logical = jnp.minimum(abs_pos // ps, P - 1)
+    page_ids = jnp.where(
+        valid, jnp.take_along_axis(page_rows, logical, axis=1), 0)
+    offsets = jnp.where(valid, abs_pos % ps, 0)
+    q_pos = jnp.where(valid, abs_pos, -1)                 # (S, C)
+    stacked = {k: params[k] for k in _STACKED}
+
+    def layer_body(x, inp):
+        layer, kp, vp = inp
+        h = _layer_norm(x, layer["ln1"]["scale"],
+                        layer["ln1"]["bias"])
+        q = jnp.einsum("scd,dhk->schk", h,
+                       _w(layer["attn"]["wq"], dt))
+        k = jnp.einsum("scd,dhk->schk", h,
+                       _w(layer["attn"]["wk"], dt))
+        v = jnp.einsum("scd,dhk->schk", h,
+                       _w(layer["attn"]["wv"], dt))
+        if cfg.pos_encoding == "rope":
+            q = _rope_bhd(q, abs_pos)
+            k = _rope_bhd(k, abs_pos)
+        # One batched scatter for the whole lane table: flatten
+        # (lane, position) — live coordinates never collide (a page
+        # is owned by exactly one sequence and a lane's positions are
+        # distinct); scratch collisions write garbage over garbage.
+        Hkv, hd = k.shape[2], k.shape[3]
+        kp, vp = _write_kv(kp, vp,
+                           k.reshape(S * C, Hkv, hd).astype(kp.dtype),
+                           v.reshape(S * C, Hkv, hd).astype(vp.dtype),
+                           page_ids.reshape(-1), offsets.reshape(-1))
+        attn = paged_attention_chunk(q, kp, vp, page_rows, q_pos)
+        x = x + jnp.einsum("schk,hkd->scd", attn,
+                           _w(layer["attn"]["wo"], dt))
+        h = _layer_norm(x, layer["ln2"]["scale"],
+                        layer["ln2"]["bias"])
+        m = layer["mlp"]
+        u = jax.nn.gelu(jnp.einsum("scd,df->scf", h,
+                                   _w(m["wi"], dt))
+                        + m["bi"].astype(dt))
+        x = x + (jnp.einsum("scf,fd->scd", u, _w(m["wo"], dt))
+                 + m["bo"].astype(dt))
+        return x, (kp, vp)
+
+    x, (k_pages_g, v_pages_g) = jax.lax.scan(
+        layer_body, x, (stacked, k_pages_g, v_pages_g))
+    return x, valid, k_pages_g, v_pages_g
+
+
+def _argmax_chain(params, x, valid, cfg):
+    """The verification chain over chunk hidden states: the ARGMAX
+    after EVERY position (position c's argmax is the verified next
+    token given tokens[:c+1]) — greedy only, by the spec/resident
+    config contract. Invalid positions emit 0."""
+    import jax.numpy as jnp
+
+    dt = jnp.dtype(cfg.dtype)
+    xs = _layer_norm(x, params["final_norm"]["scale"],
+                     params["final_norm"]["bias"])
+    head = (params["tok_embed"].T if cfg.tie_embeddings
+            else params["lm_head"])
+    logits = jnp.einsum("scd,dv->scv", xs,
+                        _w(head, dt)).astype(jnp.float32)
+    nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    return jnp.where(valid, nxt, 0)
 
 
 def _chunk_program(params, k_pages, v_pages, page_rows, tokens,
@@ -1473,92 +1873,31 @@ def _chunk_program(params, k_pages, v_pages, page_rows, tokens,
     import jax
     import jax.numpy as jnp
 
-    from distributed_training_tpu.ops.paged_attention import (
-        paged_attention_chunk)
-
     del paged_impl  # chunk form has no kernel path yet
     k_pages_g, v_pages_g = k_pages[0], v_pages[0]
     page_rows, tokens = page_rows[0], tokens[0]
     start_pos, n_valid, active = start_pos[0], n_valid[0], active[0]
     dt = jnp.dtype(cfg.dtype)
-    S, C = tokens.shape
-    P = page_rows.shape[1]
-    ps = k_pages_g.shape[3]
-    idx = jnp.arange(C, dtype=jnp.int32)
-    abs_pos = start_pos[:, None] + idx[None, :]           # (S, C)
-    valid = (idx[None, :] < n_valid[:, None]) & active[:, None]
-    x = params["tok_embed"][tokens].astype(dt)            # (S, C, D)
-    if cfg.pos_encoding == "learned":
-        safe = jnp.minimum(abs_pos, cfg.max_seq_len - 1)
-        x = x + params["pos_embed"][safe].astype(dt)
-    # Page coordinates per (lane, position); dead writes → each
-    # group's scratch page 0 (page index clamped first: padding
-    # positions of a lane near max_seq_len could index past its row).
-    logical = jnp.minimum(abs_pos // ps, P - 1)
-    page_ids = jnp.where(
-        valid, jnp.take_along_axis(page_rows, logical, axis=1), 0)
-    offsets = jnp.where(valid, abs_pos % ps, 0)
-    q_pos = jnp.where(valid, abs_pos, -1)                 # (S, C)
-    stacked = {k: params[k] for k in _STACKED}
-
-    def layer_body(x, inp):
-        layer, kp, vp = inp
-        h = _layer_norm(x, layer["ln1"]["scale"],
-                        layer["ln1"]["bias"])
-        q = jnp.einsum("scd,dhk->schk", h,
-                       layer["attn"]["wq"].astype(dt))
-        k = jnp.einsum("scd,dhk->schk", h,
-                       layer["attn"]["wk"].astype(dt))
-        v = jnp.einsum("scd,dhk->schk", h,
-                       layer["attn"]["wv"].astype(dt))
-        if cfg.pos_encoding == "rope":
-            q = _rope_bhd(q, abs_pos)
-            k = _rope_bhd(k, abs_pos)
-        # One batched scatter for the whole lane table: flatten
-        # (lane, position) — live coordinates never collide (a page
-        # is owned by exactly one sequence and a lane's positions are
-        # distinct); scratch collisions write garbage over garbage.
-        Hkv, hd = k.shape[2], k.shape[3]
-        kp, vp = _write_kv(kp, vp,
-                           k.reshape(S * C, Hkv, hd).astype(kp.dtype),
-                           v.reshape(S * C, Hkv, hd).astype(vp.dtype),
-                           page_ids.reshape(-1), offsets.reshape(-1))
-        attn = paged_attention_chunk(q, kp, vp, page_rows, q_pos)
-        x = x + jnp.einsum("schk,hkd->scd", attn,
-                           layer["attn"]["wo"].astype(dt))
-        h = _layer_norm(x, layer["ln2"]["scale"],
-                        layer["ln2"]["bias"])
-        m = layer["mlp"]
-        u = jax.nn.gelu(jnp.einsum("scd,df->scf", h,
-                                   m["wi"].astype(dt))
-                        + m["bi"].astype(dt))
-        x = x + (jnp.einsum("scf,fd->scd", u, m["wo"].astype(dt))
-                 + m["bo"].astype(dt))
-        return x, (kp, vp)
-
-    x, (k_pages_g, v_pages_g) = jax.lax.scan(
-        layer_body, x, (stacked, k_pages_g, v_pages_g))
-    head = (params["tok_embed"].T if cfg.tie_embeddings
-            else params["lm_head"])
+    S = tokens.shape[0]
+    x, valid, k_pages_g, v_pages_g = _chunk_hidden(
+        params, k_pages_g, v_pages_g, page_rows, tokens,
+        start_pos, n_valid, active, cfg=cfg)
     if emit == "all":
         # The verification chain: logits at EVERY position, argmax
         # only (spec decode is greedy by config contract).
-        xs = _layer_norm(x, params["final_norm"]["scale"],
-                         params["final_norm"]["bias"])
-        logits = jnp.einsum("scd,dv->scv", xs,
-                            head.astype(dt)).astype(jnp.float32)
-        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        return (jnp.where(valid, nxt, 0)[None],
+        return (_argmax_chain(params, x, valid, cfg)[None],
                 k_pages_g[None], v_pages_g[None])
     # emit == "last": each lane's LAST VALID position only — the
     # vocab-sized logits never leave the program.
+    head = (params["tok_embed"].T if cfg.tie_embeddings
+            else params["lm_head"])
     last = jnp.maximum(n_valid - 1, 0)[:, None, None]     # (S, 1, 1)
     x_last = jnp.take_along_axis(
         x, jnp.broadcast_to(last, (S, 1, x.shape[-1])), axis=1)[:, 0]
     x_last = _layer_norm(x_last, params["final_norm"]["scale"],
                          params["final_norm"]["bias"])
     logits = jnp.einsum("sd,dv->sv", x_last,
-                        head.astype(dt)).astype(jnp.float32)
+                        _w(head, dt)).astype(jnp.float32)
     if temperature <= 0:
         nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     else:
@@ -1572,3 +1911,147 @@ def _chunk_program(params, k_pages, v_pages, page_rows, tokens,
             jnp.int32)
     return (jnp.where(active, nxt, 0)[None],
             k_pages_g[None], v_pages_g[None])
+
+
+def _resident_program(params, k_pages, v_pages, page_rows, history,
+                      kv_len, budget, active, *, cfg, K, C, ngram,
+                      eos_id, paged_impl):
+    """Device-resident K-step decode for one dp group's slot table.
+
+    A ``lax.while_loop`` of up to ``K`` iterations; each iteration
+    is one ``C``-wide speculative chunk through ``_chunk_hidden`` —
+    the SAME forward as the host-driven spec path, so greedy token
+    identity holds by construction (drafts only ever change the
+    ACCEPTED PREFIX LENGTH, never a token value, so the in-program
+    prompt-lookup draft need not match the host-side index). Per
+    iteration each running slot drafts from its own history,
+    verifies the argmax chain, truncates at EOS, appends accepted
+    tokens to its history row and advances its KV cursor — all
+    in-program. The loop predicate exits early once every slot has
+    stopped (EOS or budget), so an all-slots-complete burst costs
+    the iterations it used, not ``K``.
+
+    k_pages/v_pages (1, L, Hkv, N, ps, hd); page_rows (1, B, P);
+    history (1, B, Lmax) int32 — prompt + generated so far, with
+    ``history[kv_len]`` the last generated token (its KV not yet
+    written, exactly the host decode invariant); kv_len (1, B) —
+    each slot's committed KV length; budget (1, B) — max tokens this
+    burst may emit per slot (the host sized it against page
+    capacity: positions written never exceed ``kv_len + budget - 1``
+    because ``kv_len + remaining_budget`` is loop-invariant);
+    active (1, B) bool.
+
+    Returns ``(out (1, B, K*C) emitted tokens, n_emitted (1, B),
+    steps (1,) loop iterations used, k_pages, v_pages)``.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    del paged_impl  # chunk form has no kernel path yet
+    kp, vp = k_pages[0], v_pages[0]
+    page_rows_g = page_rows[0]
+    history_g, kv_len_g = history[0], kv_len[0]
+    budget_g, active_g = budget[0], active[0]
+    B, Lmax = history_g.shape
+    T = K * C
+    pos = jnp.arange(Lmax, dtype=jnp.int32)
+
+    def draft_cols(hist, hlen, last):
+        """Prompt-lookup drafts (B, C-1): for each slot, the longest
+        trailing n-gram (n <= ngram) with an EARLIER occurrence in
+        ``hist[:hlen]`` proposes its continuation; slots with no
+        match repeat ``last``. Vectorized over every window at once
+        (ascending n — the longest match overwrites)."""
+        draft = jnp.broadcast_to(last[:, None], (B, C - 1))
+        for n in range(1, ngram + 1):
+            off = jnp.arange(n, dtype=jnp.int32)
+            pat_idx = jnp.clip(hlen[:, None] - n + off[None, :],
+                               0, Lmax - 1)
+            pat = jnp.take_along_axis(hist, pat_idx, axis=1)
+            win_idx = jnp.clip(pos[:, None] + off[None, :],
+                               0, Lmax - 1)             # (Lmax, n)
+            win = hist[:, win_idx]                      # (B, Lmax, n)
+            match = (win == pat[:, None, :]).all(-1)
+            # earlier occurrences only: the window's continuation
+            # position must land strictly inside history, and the
+            # trailing gram itself (start hlen-n) is excluded.
+            ok = match & ((pos[None, :] + n) < hlen[:, None])
+            has = ok.any(axis=1) & (hlen > n)
+            p = jnp.max(jnp.where(ok, pos[None, :], -1), axis=1)
+            cont_idx = (p[:, None] + n
+                        + jnp.arange(C - 1, dtype=jnp.int32)[None, :])
+            cont = jnp.take_along_axis(
+                hist, jnp.clip(cont_idx, 0, Lmax - 1), axis=1)
+            cont = jnp.where(cont_idx < hlen[:, None], cont,
+                             last[:, None])
+            draft = jnp.where(has[:, None], cont, draft)
+        return draft
+
+    def cond(carry):
+        j, _out, _n_em, _kvl, _bud, _hist, running, _kp, _vp = carry
+        return (j < K) & running.any()
+
+    def body(carry):
+        j, out, n_em, kvl, bud, hist, running, kp, vp = carry
+        n = jnp.where(running, jnp.minimum(C, bud), 0).astype(
+            jnp.int32)
+        last = jnp.take_along_axis(hist, kvl[:, None], axis=1)[:, 0]
+        if C > 1:
+            tokens = jnp.concatenate(
+                [last[:, None], draft_cols(hist, kvl + 1, last)],
+                axis=1)
+        else:
+            tokens = last[:, None]
+        x, valid, kp, vp = _chunk_hidden(
+            params, kp, vp, page_rows_g, tokens, kvl, n, running,
+            cfg=cfg)
+        nxt = _argmax_chain(params, x, valid, cfg)      # (B, C)
+        if C > 1:
+            sl = jnp.arange(C - 1, dtype=jnp.int32)
+            match = ((tokens[:, 1:] == nxt[:, :-1])
+                     & (sl[None, :] < (n - 1)[:, None]))
+            e = 1 + jnp.sum(
+                jnp.cumprod(match.astype(jnp.int32), axis=1), axis=1)
+        else:
+            e = jnp.ones((B,), jnp.int32)
+        e = jnp.where(n > 0, e, 0).astype(jnp.int32)
+        cl = jnp.arange(C, dtype=jnp.int32)
+        if eos_id >= 0:
+            is_eos = (nxt == eos_id) & (cl[None, :] < e[:, None])
+            any_eos = is_eos.any(axis=1)
+            e = jnp.where(
+                any_eos,
+                jnp.argmax(is_eos, axis=1).astype(jnp.int32) + 1, e)
+        else:
+            any_eos = jnp.zeros((B,), jnp.bool_)
+        # scatter this iteration's accepted tokens into the output
+        # block at each slot's emission cursor, and append them to
+        # the history row right after its current last token.
+        rel = (jnp.arange(T, dtype=jnp.int32)[None, :]
+               - n_em[:, None])
+        sel = (rel >= 0) & (rel < e[:, None])
+        vals = jnp.take_along_axis(nxt, jnp.clip(rel, 0, C - 1),
+                                   axis=1)
+        out = jnp.where(sel, vals, out)
+        hrel = pos[None, :] - (kvl + 1)[:, None]
+        hsel = (hrel >= 0) & (hrel < e[:, None])
+        hist = jnp.where(
+            hsel,
+            jnp.take_along_axis(nxt, jnp.clip(hrel, 0, C - 1),
+                                axis=1),
+            hist)
+        n_em = n_em + e
+        kvl = kvl + e
+        bud = bud - e
+        running = running & (bud > 0) & ~any_eos
+        return (j + 1, out, n_em, kvl, bud, hist, running, kp, vp)
+
+    init = (jnp.zeros((), jnp.int32),
+            jnp.zeros((B, T), jnp.int32),
+            jnp.zeros((B,), jnp.int32),
+            kv_len_g, budget_g, history_g,
+            active_g & (budget_g > 0), kp, vp)
+    j, out, n_em, _kvl, _bud, _hist, _run, kp, vp = \
+        jax.lax.while_loop(cond, body, init)
+    return (out[None], n_em[None], jnp.reshape(j, (1,)),
+            kp[None], vp[None])

@@ -283,6 +283,16 @@ class PagedKVCache:
     def length(self, seq_id) -> int:
         return self._lengths[seq_id]
 
+    def token_capacity(self, seq_id) -> int:
+        """Max TOTAL positions this sequence could hold right now:
+        its allocated pages plus everything left on its group's free
+        list, capped by max_seq_len. The resident decode path sizes
+        burst budgets against this so an in-program loop can never
+        out-write what ``ensure`` could cover."""
+        g = self._groups[seq_id]
+        pages = len(self._tables[seq_id]) + len(self._frees[g])
+        return min(pages * self.cfg.page_size, self.cfg.max_seq_len)
+
     def occupancy(self) -> dict:
         rec = {"pages_used": self.pages_used,
                "pages_total": self.cfg.usable_pages_total,

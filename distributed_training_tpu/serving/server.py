@@ -142,7 +142,7 @@ class ServingServer:
             rid = f"http-{self._next_id}"
             self._next_id += 1
             self._events[rid] = ev
-            self._mailbox.append((rid, np.asarray(prompt, np.int32),
+            self._mailbox.append((rid, np.array(prompt, np.int32),
                                   int(max_new_tokens), arrival))
         if not ev.wait(timeout):
             with self._lock:
@@ -168,7 +168,7 @@ class ServingServer:
             rid = f"http-{self._next_id}"
             self._next_id += 1
             self._streams[rid] = q
-            self._mailbox.append((rid, np.asarray(prompt, np.int32),
+            self._mailbox.append((rid, np.array(prompt, np.int32),
                                   int(max_new_tokens), arrival))
         deadline = time.monotonic() + timeout
         try:
@@ -191,7 +191,7 @@ class ServingServer:
                        "latency_s": val["latency_s"]}
                 if self.engine.model.cfg.vocab_size == 256:
                     out["text"] = bytes(
-                        np.asarray(val["tokens"], np.uint8)).decode(
+                        np.array(val["tokens"], np.uint8)).decode(
                             "utf-8", errors="replace")
                 yield out
                 return
@@ -215,7 +215,7 @@ class ServingServer:
         happen while the status line is still writable."""
         vocab = self.engine.model.cfg.vocab_size
         if "prompt_ids" in body:
-            ids = np.asarray([int(t) for t in body["prompt_ids"]],
+            ids = np.array([int(t) for t in body["prompt_ids"]],
                              np.int32)
         elif "text" in body:
             if vocab != 256:
@@ -248,7 +248,7 @@ class ServingServer:
                "latency_s": rec["latency_s"]}
         if self.engine.model.cfg.vocab_size == 256:
             out["text"] = bytes(
-                np.asarray(rec["tokens"], np.uint8)).decode(
+                np.array(rec["tokens"], np.uint8)).decode(
                     "utf-8", errors="replace")
         return out
 
@@ -412,7 +412,7 @@ def engine_config_from_yaml(plan, engine_block: dict):
             if k in ("max_batch", "num_pages", "max_seq_len",
                      "policy", "temperature", "top_k",
                      "prefill_slots", "prefill_mode", "spec_k",
-                     "spec_ngram")
+                     "spec_ngram", "resident_k", "eos_id")
             and v not in (0, 0.0, None, "")}
     return dataclasses.replace(base, **over)
 

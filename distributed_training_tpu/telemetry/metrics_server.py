@@ -199,6 +199,16 @@ class MetricsServer:
                     # emitted per slot-launch, serving/engine.py).
                     self._gauges["serving_spec_accepted_mean"] = \
                         float(rec["spec_accepted_mean"])
+                # Device-resident decode + weight-store gauges
+                # (SERVING_r04, serving/engine.py step records).
+                for src, dst in (
+                        ("host_syncs_per_token",
+                         "serving_host_syncs_per_token"),
+                        ("resident_steps_per_launch",
+                         "serving_resident_steps_per_launch"),
+                        ("weight_bytes", "serving_weight_bytes")):
+                    if isinstance(rec.get(src), (int, float)):
+                        self._gauges[dst] = float(rec[src])
                 # Per-dp-group shard gauges (the dp-sharded engine's
                 # step records carry per-group lists — serving/
                 # engine.py + kv_cache.occupancy; schema pinned by
@@ -310,6 +320,17 @@ class MetricsServer:
         "serving_spec_accepted_mean": "Speculative decode mean "
                                       "accepted chain length, last "
                                       "decode step",
+        "serving_host_syncs_per_token": "Device-to-host syncs per "
+                                        "emitted token, last engine "
+                                        "step (resident decode "
+                                        "drives this toward 1/K)",
+        "serving_resident_steps_per_launch": "Mean while_loop "
+                                             "iterations per "
+                                             "device-resident burst, "
+                                             "last decode step",
+        "serving_weight_bytes": "Bytes of the engine's resident "
+                                "weight tree (int8 stores shrink "
+                                "this ~4x vs fp32)",
         "serving_requests_total": "Requests completed by the engine",
         "serving_group_slots_active": "Active decode slots per dp "
                                       "group (dp-sharded engine)",

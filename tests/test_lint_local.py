@@ -66,7 +66,7 @@ def test_lint_and_analysis_share_one_rule_table():
     finally:
         sys.path.pop(0)
     assert {"DTT001", "DTT002", "DTT003", "DTT004", "DTT005",
-            "DTT006", "DTT007", "DTT008"} <= set(
+            "DTT006", "DTT007", "DTT008", "DTT009", "DTT010"} <= set(
         lint_local.pitfalls.RULES)
 
 
@@ -154,3 +154,64 @@ def test_lint_local_silent_swallow_rule(tmp_path):
         "    pass\n")
     assert [p for p in lint_local.check_file(str(other))
             if "DTT002" in p]
+
+
+def test_lint_local_serving_sync_rule():
+    """DTT010: host syncs in serving/ outside the designated helpers
+    fail; the helpers themselves, `jnp.asarray`, `np.array`, and
+    noqa'd deliberate syncs pass; files outside serving/ are out of
+    scope (DTT003 owns the trainer). Uses `text=` against serving
+    rel paths so nothing is written into the package."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import lint_local
+    finally:
+        sys.path.pop(0)
+    pf = lint_local.pitfalls
+    eng = os.path.join(REPO, "distributed_training_tpu", "serving",
+                       "engine.py")
+    bad = (
+        "import jax\nimport numpy as np\n"
+        "def step(x):\n"
+        "    a = jax.device_get(x)\n"
+        "    x.block_until_ready()\n"
+        "    b = np.asarray(x)\n"
+        "    return a, b\n")
+    hits = [p for p in pf.check_file_rules(eng, repo=REPO, text=bad)
+            if "DTT010" in p]
+    assert len(hits) == 3, hits
+    ok = (
+        "import jax\nimport jax.numpy as jnp\nimport numpy as np\n"
+        "def _fetch_host(*arrays):\n"
+        "    return jax.device_get(arrays)\n"
+        "def step(x, raw):\n"
+        "    y = jnp.asarray(raw)\n"
+        "    z = np.array(raw, np.int32)\n"
+        "    w = jax.device_get(x)  # noqa: DTT010\n"
+        "    return y, z, w\n")
+    assert not [p for p in pf.check_file_rules(eng, repo=REPO, text=ok)
+                if "DTT010" in p]
+    # A noqa for a DIFFERENT code must not disable this rule.
+    other = ("import jax\n"
+             "def step(x):\n"
+             "    return jax.device_get(x)  # noqa: E501\n")
+    assert [p for p in pf.check_file_rules(eng, repo=REPO, text=other)
+            if "DTT010" in p]
+    # Outside serving/ the rule does not apply (DTT003 owns the
+    # trainer's hot path; this one owns serving's).
+    tr = os.path.join(REPO, "distributed_training_tpu", "train",
+                      "somewhere.py")
+    assert not [p for p in pf.check_file_rules(tr, repo=REPO, text=bad)
+                if "DTT010" in p]
+    # disagg's KV export/import are the other designated sync point:
+    # their np.asarray on device slices IS the prefill→decode handoff.
+    dis = os.path.join(REPO, "distributed_training_tpu", "serving",
+                       "disagg.py")
+    helper = ("import numpy as np\n"
+              "def export_kv_batch(cache, seq_ids):\n"
+              "    return np.asarray(cache)\n"
+              "def elsewhere(cache):\n"
+              "    return np.asarray(cache)\n")
+    hits = [p for p in pf.check_file_rules(dis, repo=REPO, text=helper)
+            if "DTT010" in p]
+    assert len(hits) == 1 and ":5:" in hits[0], hits

@@ -17,7 +17,7 @@ is red when a violation lands:
 - isort subset (profile=black): within each contiguous top-of-file
   import block, `import`-group ordering stdlib < third-party <
   first-party and alphabetical order inside each group.
-- DTT001–DTT006 (repo rules, not flake8): the JAX-pitfall rule
+- DTT001–DTT010 (repo rules, not flake8): the JAX-pitfall rule
   registry in ``distributed_training_tpu/analysis/pitfalls.py`` —
   bare jsonl writes, silent broad swallows, hot-path host syncs,
   host-local collective guards, PRNG key reuse, undonated train
@@ -152,7 +152,7 @@ def check_file(path: str) -> list[str]:
                     f"{rel}:{lineno}: F401 '{name}' imported but "
                     "unused")
 
-    # Repo rules DTT001–DTT006: the shared registry (parse reused).
+    # Repo rules DTT001–DTT010: the shared registry (parse reused).
     problems += pitfalls.check_file_rules(path, repo=REPO, text=text,
                                           tree=tree)
 
