@@ -2,7 +2,10 @@
 
 The measured half of ROADMAP item 1 ("millions of users, heavy
 traffic" as a number, not a slogan). The SAME seeded workload as
-SERVING_r01–r03, now against the r04 engine (serving/engine.py:
+SERVING_r01–r04, now against the r05 engine (serving/engine.py:
+PREFIX-SHARING PAGED KV — refcounted copy-on-write pages, a prefix
+index that admits shared system prompts without re-prefilling them,
+and retained chat sessions that re-attach with zero prefill — over
 DEVICE-RESIDENT DECODE — up to ``resident_k`` speculative chunk
 steps per launch kept on device in a ``lax.while_loop``, in-program
 drafting/accept/stop, ONE host sync per burst — over the r03 batched
@@ -42,10 +45,20 @@ lane rides the same run under the committed int8 plan
   (bursts are atomic host-side); the next incarnation resubmits and
   drains. Records goodput and asserts the final token streams are
   IDENTICAL to the steady storm's.
+- **shared-prefix storm (SERVING_r05)** — N tenants share a
+  48-token system prompt (3 full pages) with unique tails: the
+  prefix-sharing engine prefills the header once per dp group and
+  attaches it refcounted thereafter, the sharing-DISABLED engine
+  same-run recomputes it per tenant. Prefill tokens actually
+  computed must drop ≥4×, token streams must be IDENTICAL, and a
+  page-aligned fork demonstrates zero-prefill admission + a
+  copy-on-write page. A chat-session phase then proves the
+  zero-prefill re-attach: an exact follow-up turn launches NO
+  prefill program at all.
 
-Writes ``SERVING_r04.json`` at the repo root::
+Writes ``SERVING_r05.json`` at the repo root::
 
-    python benchmarks/bench_serving.py --out SERVING_r04.json
+    python benchmarks/bench_serving.py --out SERVING_r05.json
 """
 
 from __future__ import annotations
@@ -94,7 +107,9 @@ def build_workload(n_requests: int, rate_per_s: float, seed: int,
 
 def make_engine(store, plan, mesh, prefill_chunk: int = 32,
                 spec_k: int = 1, prefill_mode: str = "batched",
-                resident_k: int = 1):
+                resident_k: int = 1, prefix_sharing: bool = True):
+    import dataclasses
+
     from distributed_training_tpu.parallel.planner import (
         model_for_plan)
     from distributed_training_tpu.serving.disagg import (
@@ -105,14 +120,19 @@ def make_engine(store, plan, mesh, prefill_chunk: int = 32,
     # prefills in ONE chunk; since r03 the batched lane table packs
     # up to max_batch such chunks into ONE LAUNCH. spec_k > 1 turns
     # on the multi-token speculative chunks; resident_k > 1 keeps
-    # that many chunk steps on device per launch (SERVING_r04).
+    # that many chunk steps on device per launch (SERVING_r04);
+    # prefix_sharing=False builds the sharing-disabled comparison
+    # engine for the r05 shared-prefix storm gate.
+    ecfg = engine_config_for_plan(plan,
+                                  prefill_chunk=prefill_chunk,
+                                  prefill_mode=prefill_mode,
+                                  spec_k=spec_k,
+                                  resident_k=resident_k)
+    if not prefix_sharing:
+        ecfg = dataclasses.replace(ecfg, prefix_sharing=False)
     return Engine(model_for_plan(plan),
                   store.params_for(mesh, plan),
-                  engine_config_for_plan(plan,
-                                         prefill_chunk=prefill_chunk,
-                                         prefill_mode=prefill_mode,
-                                         spec_k=spec_k,
-                                         resident_k=resident_k),
+                  ecfg,
                   mesh=mesh)
 
 
@@ -281,10 +301,16 @@ def main(argv=None) -> int:
     ap.add_argument("--preempt-after", type=int, default=12,
                     help="preempt the engine after this many "
                          "completions (mid-storm)")
+    ap.add_argument("--tenants", type=int, default=32,
+                    help="shared-prefix storm tenant count")
+    ap.add_argument("--prefix-tokens", type=int, default=48,
+                    help="common system-prompt length for the "
+                         "shared-prefix storm (3 full pages at the "
+                         "16-token page size)")
     ap.add_argument("--out", default=_os.path.join(
-        REPO, "SERVING_r04.json"))
+        REPO, "SERVING_r05.json"))
     ap.add_argument("--compare", default=_os.path.join(
-        REPO, "SERVING_r03.json"),
+        REPO, "SERVING_r04.json"),
         help="previous ledger entry for the in-entry compared_to "
              "block ('' disables)")
     ap.add_argument("--parity-sample", type=int, default=6,
@@ -620,6 +646,199 @@ def main(argv=None) -> int:
                 f"{int8_block['weight_bytes_fp32']}B")
         del eng_q, eng_fp
 
+    # -- shared-prefix storm: the r05 headline -------------------------
+    # N tenants share a page-aligned system prompt with unique 2-6
+    # token tails. A first wave of one tenant per dp group primes the
+    # prefix index (their session keys retain the pages, so the index
+    # survives their completion); every later tenant attaches the
+    # shared pages refcounted and prefills ONLY its tail. The
+    # sharing-DISABLED engine runs the identical workload same-run:
+    # the ratio of prefill tokens actually computed is the gated ≥4×
+    # claim, and the token streams must be byte-identical (sharing
+    # changes page tables, never logits).
+    prng = np.random.default_rng(args.seed + 101)
+    common = prng.integers(
+        0, 256, size=args.prefix_tokens).astype(np.int32)
+    tenants = []
+    for i in range(args.tenants):
+        tail = prng.integers(
+            0, 256, size=int(prng.integers(2, 7))).astype(np.int32)
+        tenants.append((f"tenant-{i}",
+                        np.concatenate([common, tail])))
+
+    def prefix_storm(eng, primers):
+        warm = eng.warmup()
+        pt0 = eng.prefill_tokens_computed
+        for rid, prompt in tenants[:primers]:
+            eng.submit(Request(id=rid, prompt=prompt,
+                               max_new_tokens=8,
+                               session=f"primer-{rid}"))
+        eng.run_until_drained()
+        for rid, prompt in tenants[primers:]:
+            eng.submit(Request(id=rid, prompt=prompt,
+                               max_new_tokens=8))
+        eng.run_until_drained()
+        if eng.compile_counts() != warm:
+            raise AssertionError("recompiled during prefix storm")
+        return (eng.prefill_tokens_computed - pt0,
+                {r["id"]: r["tokens"] for r in eng.completed})
+
+    eng_share = make_engine(store, plan, mesh, args.prefill_chunk,
+                            spec_k=args.spec_k,
+                            resident_k=args.resident_k)
+    share_tokens, share_streams = prefix_storm(
+        eng_share, primers=eng_share.dp_groups)
+    eng_off = make_engine(store, plan, mesh, args.prefill_chunk,
+                          spec_k=args.spec_k,
+                          resident_k=args.resident_k,
+                          prefix_sharing=False)
+    off_tokens, off_streams = prefix_storm(
+        eng_off, primers=eng_off.dp_groups)
+    if share_streams != off_streams:
+        diff = [rid for rid in share_streams
+                if share_streams[rid] != off_streams.get(rid)]
+        raise AssertionError(
+            f"prefix sharing changed token streams for {diff}")
+    for rid, prompt in tenants[:: max(1, args.tenants // 4)]:
+        want = full_context_greedy(model, params, prompt,
+                                   len(share_streams[rid]),
+                                   plan.seq_len)
+        if share_streams[rid] != want:
+            raise AssertionError(
+                f"{rid}: shared-prefix stream diverged from the "
+                f"full-context reference: {share_streams[rid]} != "
+                f"{want}")
+    reduction = round(off_tokens / share_tokens, 3)
+    if reduction < 4.0:
+        raise AssertionError(
+            f"prefix sharing computed {share_tokens} prefill tokens "
+            f"vs {off_tokens} sharing-disabled — {reduction}x is "
+            "below the 4x acceptance gate")
+    followers = args.tenants - eng_share.dp_groups
+    if eng_share.prefix_stats["hit_tokens"] \
+            < followers * args.prefix_tokens:
+        raise AssertionError(
+            f"prefix hits {eng_share.prefix_stats['hit_tokens']} — "
+            f"some of the {followers} follower tenants missed the "
+            "resident header")
+
+    # Page-aligned fork on the SAME warmed engine: tenant fork-a's
+    # 32-token prompt is retained (session); fork-b submits the
+    # identical prompt — a FULL page-aligned match, so it admits with
+    # ZERO prefill tokens and its first decode write forks the shared
+    # boundary page copy-on-write.
+    fp = prng.integers(0, 256, size=32).astype(np.int32)
+    eng_share.submit(Request(id="fork-a", prompt=fp,
+                             max_new_tokens=8, session="fork"))
+    eng_share.run_until_drained()
+    pt0 = eng_share.prefill_tokens_computed
+    cow0 = eng_share.prefix_stats["cow_pages"]
+    eng_share.submit(Request(id="fork-b", prompt=fp.copy(),
+                             max_new_tokens=8))
+    eng_share.run_until_drained()
+    fork_tokens = eng_share.prefill_tokens_computed - pt0
+    cow_pages = eng_share.prefix_stats["cow_pages"] - cow0
+    forks = {r["id"]: r["tokens"] for r in eng_share.completed
+             if r["id"].startswith("fork-")}
+    if fork_tokens != 0:
+        raise AssertionError(
+            f"page-aligned full match still prefilled {fork_tokens} "
+            "tokens")
+    if cow_pages < 1:
+        raise AssertionError(
+            "fork-b never copy-on-wrote the shared boundary page")
+    if forks["fork-b"] != forks["fork-a"]:
+        raise AssertionError(
+            f"COW fork diverged: {forks['fork-b']} != "
+            f"{forks['fork-a']}")
+    prefix = {
+        "tenants": args.tenants,
+        "common_prefix_tokens": args.prefix_tokens,
+        "tail_tokens": "uniform[2,6]",
+        "max_new_tokens": 8,
+        "primer_waves": eng_share.dp_groups,
+        "prefill_tokens_computed": share_tokens,
+        "prefix_hit_tokens": eng_share.prefix_stats["hit_tokens"],
+        "prefill_tokens_saved":
+            eng_share.prefix_stats["saved_tokens"],
+        "cow_pages": eng_share.prefix_stats["cow_pages"],
+        "zero_prefill_fork": {"prefill_tokens_computed": 0,
+                              "cow_pages": cow_pages,
+                              "tokens_match_retained_twin": True},
+        "tokens_match_sharing_disabled": True,
+        "greedy_matches_full_context": True,
+        "recompiles_after_warmup": 0,
+        "compared_to": {
+            "engine": "prefix sharing disabled, same run, same "
+                      "workload",
+            "prefill_tokens_computed": off_tokens,
+            "reduction_x": reduction,
+        },
+    }
+
+    # -- chat sessions: zero-prefill re-attach -------------------------
+    # Turn 1 retains its pages under the session key; the EXACT
+    # follow-up (prompt == full retained history) re-attaches with
+    # zero prefill LAUNCHES — not a shorter prefill, none at all. An
+    # extended follow-up (history + new user tokens) prefills only
+    # the unseen suffix.
+    chat = prng.integers(0, 256, size=16).astype(np.int32)
+    eng_share.submit(Request(id="chat-1", prompt=chat,
+                             max_new_tokens=8, session="chat"))
+    eng_share.run_until_drained()
+    t1 = next(r for r in eng_share.completed
+              if r["id"] == "chat-1")["tokens"]
+    hist1 = np.concatenate([chat, np.asarray(t1, np.int32)])
+    pl0 = eng_share.prefill_launches
+    pt0 = eng_share.prefill_tokens_computed
+    eng_share.submit(Request(id="chat-2", prompt=hist1,
+                             max_new_tokens=4, session="chat"))
+    eng_share.run_until_drained()
+    t2 = next(r for r in eng_share.completed
+              if r["id"] == "chat-2")["tokens"]
+    resume_launches = eng_share.prefill_launches - pl0
+    resume_tokens = eng_share.prefill_tokens_computed - pt0
+    if resume_launches or resume_tokens:
+        raise AssertionError(
+            f"exact session resume ran {resume_launches} prefill "
+            f"launches / {resume_tokens} tokens — the zero-prefill "
+            "re-attach claim does not hold")
+    if t2 != full_context_greedy(model, params, hist1, len(t2),
+                                 plan.seq_len):
+        raise AssertionError("session resume diverged from the "
+                             "full-context reference")
+    hist2 = np.concatenate(
+        [hist1, np.asarray(t2, np.int32),
+         prng.integers(0, 256, size=3).astype(np.int32)])
+    pt0 = eng_share.prefill_tokens_computed
+    eng_share.submit(Request(id="chat-3", prompt=hist2,
+                             max_new_tokens=4, session="chat"))
+    eng_share.run_until_drained()
+    t3 = next(r for r in eng_share.completed
+              if r["id"] == "chat-3")["tokens"]
+    extended_tokens = eng_share.prefill_tokens_computed - pt0
+    if t3 != full_context_greedy(model, params, hist2, len(t3),
+                                 plan.seq_len):
+        raise AssertionError("extended session turn diverged from "
+                             "the full-context reference")
+    session = {
+        "first_turn": {"prompt_tokens": int(len(chat)),
+                       "new_tokens": len(t1)},
+        "resume_exact": {"prompt_tokens": int(len(hist1)),
+                         "prefill_launches": 0,
+                         "prefill_tokens_computed": 0,
+                         "new_tokens": len(t2)},
+        "resume_extended": {
+            "prompt_tokens": int(len(hist2)),
+            "prefill_tokens_computed": extended_tokens},
+        "zero_prefill_resume": True,
+        "session_resumes":
+            eng_share.prefix_stats["session_resumes"],
+        "sessions_resident": len(eng_share.sessions),
+        "tokens_match_full_context": True,
+    }
+    del eng_share, eng_off
+
     # -- storm 2: supervised mid-storm preemption ----------------------
     state = {"workload": workload, "incarnations": [],
              "completed": [], "wasted_tokens": 0, "downtime_s": 0.0}
@@ -688,8 +907,9 @@ def main(argv=None) -> int:
     if args.compare and _os.path.exists(args.compare):
         with open(args.compare, encoding="utf-8") as f:
             prev = json.load(f)
-        # r03's acceptance number was its SATURATED aggregate drain
-        # (the realtime storm is arrival-bound either way).
+        # The r04/r03 acceptance numbers were their SATURATED
+        # aggregate drains (the realtime storm is arrival-bound
+        # either way).
         prev_sat = (prev.get("saturated") or {}).get("tokens_per_s") \
             or prev["steady"]["tokens_per_s"]
         prev_steady = prev["steady"]["tokens_per_s"]
@@ -701,14 +921,15 @@ def main(argv=None) -> int:
             "ttft_s": prev["steady"]["ttft_s"],
             "per_token_latency_s":
                 prev["steady"]["per_token_latency_s"],
-            "engine": "dp-sharded spec_k-chunk decode, one launch + "
-                      "one host sync per step (r03)",
+            "engine": "device-resident decode + int8 lane, no "
+                      "prefix sharing (r04)",
             # Cross-run context (shared-container wall clocks are
-            # noisy; the GATED claims are the same-run comparisons
-            # in the prefill and saturated blocks above). The r04
-            # acceptance gate IS cross-run — >= 1.5x the committed
-            # r03 saturated number — so the resident engine must
-            # clear it on the same seeded workload r03 measured.
+            # noisy; the GATED r05 claim is the SAME-RUN ≥4x
+            # prefill-token reduction in the prefix block above —
+            # sharing is a prefill-compute lever, not a decode-
+            # throughput one). The cross-run bound here is a
+            # NON-REGRESSION guard: the refcount/COW bookkeeping
+            # must not tank saturated decode.
             "speedup": round(
                 saturated["tokens_per_s"] / prev_sat, 3)
             if prev_sat else None,
@@ -716,16 +937,16 @@ def main(argv=None) -> int:
                 steady["tokens_per_s"] / prev_steady, 3)
             if prev_steady else None,
         }
-        if prev_sat and saturated["tokens_per_s"] < 1.5 * prev_sat:
+        if prev_sat and saturated["tokens_per_s"] < 0.75 * prev_sat:
             raise AssertionError(
-                f"resident decode {saturated['tokens_per_s']} tok/s "
-                f"is below the 1.5x acceptance gate vs r03's "
-                f"saturated {prev_sat}")
+                f"saturated decode {saturated['tokens_per_s']} "
+                f"tok/s regressed below 0.75x r04's {prev_sat} — "
+                "the sharing bookkeeping is too expensive")
 
     doc = {
         "schema": SCHEMA,
         "bench": "serving",
-        "revision": "r04",
+        "revision": "r05",
         "recorded_unix": int(time.time()),
         "plan": {"name": plan.name,
                  "fingerprint": plan.fingerprint(),
@@ -755,6 +976,8 @@ def main(argv=None) -> int:
         "int8": int8_block,
         "streaming": streaming,
         "preemption": preemption,
+        "prefix": prefix,
+        "session": session,
         "compared_to": compared_to,
         "note": "Tiny serving model (SERVING_MODEL_KWARGS) on the "
                 "fake CPU mesh — an honest CPU-scale measurement of "
@@ -787,7 +1010,16 @@ def main(argv=None) -> int:
                 "pinned reshard-clean by the serving_resident_"
                 "planned analysis target; the int8 plan re-plans "
                 "under the 4x weight-residency credit "
-                "(dp-only, zero decode collectives).",
+                "(dp-only, zero decode collectives), and since r05 "
+                "its residual-HBM credit is spent on KV pages "
+                "(provenance kv_pool_tokens). (5) the r05 prefix "
+                "gate counts prefill tokens COMPUTED, not wall "
+                "clock: on this tiny model the launch overhead "
+                "dominates, so the token reduction is the honest "
+                "hardware-independent number — sharing changes page "
+                "tables only, never program shapes "
+                "(recompiles_after_warmup=0 re-asserted) or logits "
+                "(streams byte-identical to sharing-disabled).",
     }
     with open(args.out, "w", encoding="utf-8") as f:
         json.dump(doc, f, indent=1, sort_keys=True)
@@ -805,7 +1037,10 @@ def main(argv=None) -> int:
                           "tokens_per_s"),
                       "prefill_tokens_per_s":
                           prefill["batched"]["prefill_tokens_per_s"],
-                      "speedup_vs_r03": (compared_to or {}).get(
+                      "prefix_reduction_x":
+                          prefix["compared_to"]["reduction_x"],
+                      "session_resume_prefill_launches": 0,
+                      "saturated_vs_r04": (compared_to or {}).get(
                           "speedup"),
                       "streamed_ttft_first_byte_s":
                           streaming["ttft_first_byte_s"],

@@ -900,6 +900,22 @@ def _score_serving(target: PlanTarget, cand: Candidate,
         if total > budget:
             rec.update(feasible=False, reason="hbm", score=0.0)
             return rec
+        # SERVING_r05: spend the residual HBM credit — weight bytes
+        # vacated by int8 plus whatever the layout leaves free — on
+        # KV pages instead of leaving it idle. kv_pool_tokens is the
+        # pool the ENGINE should size (serving/disagg.py
+        # engine_config_for_plan consumes it); kv_pool_gib_delta
+        # records the provenance of the grown pool vs the minimal
+        # slots*seq_len one. Informational only: the score value is
+        # untouched, so committed rankings and fingerprints of other
+        # plans stay --check-clean without a rewrite.
+        rec["kv_pool_tokens"] = max(slots * S,
+                                    rec["kv_capacity_tokens"])
+        rec["kv_pool_sized_gib"] = round(
+            rec["kv_pool_tokens"] * kv_tok
+            / (cand.dp * cand.tp) / 2**30, 6)
+        rec["kv_pool_gib_delta"] = round(
+            rec["kv_pool_sized_gib"] - rec["kv_pool_gib"], 6)
         # Forward FLOPs for one token across the aggregate batch
         # (fwd ≈ 1/3 of the fwd+bwd accounting); dp shards the rows,
         # tp the per-row math.

@@ -400,7 +400,14 @@ def engine_config_for_plan(plan, page_size: int = 16,
     HBM the replicated-table engine reserved, now batch-sharded.
     ``prefill_mode``/``spec_k`` select the batched-prefill and
     speculative-decode programs (SERVING_r03); the plan's layout is
-    program-agnostic — dp deals lanes, tp shards heads, either way."""
+    program-agnostic — dp deals lanes, tp shards heads, either way.
+
+    Pool sizing (SERVING_r05): when the plan's provenance carries
+    ``kv_pool_tokens`` (the planner's residual-HBM-credit sizing —
+    int8 plans vacate weight bytes that become KV pages), each
+    group's shard is grown to hold its share of that token budget;
+    plans without the field keep the minimal slots-fit-at-full-length
+    pool, so pre-r05 plan files stay valid."""
     slots = plan.batch_per_shard
     dp = plan.mesh.get("dp", 1)
     if slots % dp:
@@ -409,10 +416,16 @@ def engine_config_for_plan(plan, page_size: int = 16,
             f"deal over dp={dp} — the planner must not emit this "
             "(slots%dp feasibility)")
     pages_per_seq = -(-plan.seq_len // page_size)
+    num_pages = (slots // dp) * pages_per_seq + 1
+    pool_tokens = ((plan.provenance or {}).get("score") or {}).get(
+        "kv_pool_tokens")
+    if isinstance(pool_tokens, int) and pool_tokens > 0:
+        num_pages = max(num_pages,
+                        -(-(pool_tokens // dp) // page_size) + 1)
     return EngineConfig(
         max_batch=slots,
         page_size=page_size,
-        num_pages=(slots // dp) * pages_per_seq + 1,
+        num_pages=num_pages,
         max_seq_len=plan.seq_len,
         prefill_chunk=prefill_chunk,
         prefill_mode=prefill_mode,

@@ -125,6 +125,7 @@ class EngineConfig:
     spec_k: int = 1               # decode tokens per launch (1 = off)
     spec_ngram: int = 3           # longest prompt-lookup n-gram tried
     resident_k: int = 1           # device-resident decode steps (1 = off)
+    prefix_sharing: bool = True   # refcounted prefix reuse + sessions
     eos_id: int = -1              # stop token (< 0 = disabled)
     policy: str = "prefill"       # "prefill" | "decode" priority
     temperature: float = 0.0
@@ -176,12 +177,18 @@ class EngineConfig:
 
 @dataclass
 class Request:
-    """One generation request. ``arrival`` defaults to submit time."""
+    """One generation request. ``arrival`` defaults to submit time.
+    ``session``: chat-session key — on completion the sequence's KV
+    pages are RETAINED under this key instead of freed, and a later
+    request with the same key whose prompt extends the retained
+    history re-attaches them (zero prefill for the shared part;
+    an exact-history prompt needs zero prefill launches at all)."""
 
     id: str
     prompt: np.ndarray
     max_new_tokens: int
     arrival: float | None = None
+    session: str | None = None
 
 
 @dataclass
@@ -198,6 +205,17 @@ class _Seq:
     @property
     def prompt_len(self) -> int:
         return int(self.req.prompt.shape[0])
+
+    @property
+    def last_token(self) -> int:
+        """The token the next decode launch feeds. A zero-prefill
+        admission (full prefix hit / exact session resume) starts
+        decoding with NOTHING generated yet — it replays the last
+        PROMPT token at its already-resident position (the COW'd
+        boundary page takes the rewrite), which samples exactly the
+        first token a prefill launch would have."""
+        return int(self.generated[-1]) if self.generated \
+            else int(self.req.prompt[-1])
 
     @property
     def prefill_done(self) -> bool:
@@ -548,6 +566,48 @@ def build_resident_decode_fn(model_cfg, ecfg: EngineConfig,
     return jax.jit(body, donate_argnums=(1, 2), **kw)
 
 
+def _cow_program(k_pages, v_pages, src, dst):
+    """Copy-on-write page copy for one dp group's pool shard:
+    ``k/v_pages`` (1, L, Hkv, N, ps, hd), ``src``/``dst`` (1, W)
+    int32 page ids. One batched gather + scatter per pool — W page
+    copies in ONE launch, no per-token host sync, zero collectives
+    (pages never cross a group shard). Unused lanes ride as
+    (0 -> 0): a scratch-to-scratch identity copy, the same dead-write
+    trick as the decode program's inactive slots."""
+    s, d = src[0], dst[0]
+
+    def copy(pages):
+        g = pages[0]                       # (L, Hkv, N, ps, hd)
+        return g.at[:, :, d].set(g[:, :, s])[None]
+
+    return copy(k_pages), copy(v_pages)
+
+
+def build_cow_fn(model_cfg, ecfg: EngineConfig, mesh=None):
+    """The jitted COW page-copy program. Signature:
+    ``fn(k_pages, v_pages, src (G, W), dst (G, W)) -> (k_pages,
+    v_pages)`` — pools donated (the copy must not double the serving
+    HBM's dominant term), fixed W so a storm's forks never change a
+    traced shape."""
+    import jax
+
+    body = _cow_program
+    kw = {}
+    if mesh is not None:
+        _grp, pool = _out_shardings(model_cfg, ecfg, mesh)
+        kw["out_shardings"] = (pool, pool)
+    if _dp_extent(mesh, ecfg.dp_axis) > 1:
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        grouped = P(ecfg.dp_axis)
+        body = shard_map(
+            body, mesh=mesh, in_specs=(grouped,) * 4,
+            out_specs=(grouped,) * 2, check_rep=False,
+            auto=frozenset(mesh.axis_names) - {ecfg.dp_axis})
+    return jax.jit(body, donate_argnums=(0, 1), **kw)
+
+
 class Engine:
     """The continuous-batching engine over one model + weight set.
 
@@ -603,6 +663,25 @@ class Engine:
         self.resident_stats = {"launches": 0, "steps": 0,
                                "emitted": 0}
         self._step_resident: tuple[float, int] | None = None
+        # Prefix sharing + chat sessions (SERVING_r05). ``sessions``
+        # maps session key -> retained state (cache id holding the
+        # parked pages, the full token history they cover, the owning
+        # dp group, last-use time for LRU eviction under pool
+        # pressure). The stats totals feed the bench ledger; the
+        # per-step pair feeds the step record the metrics endpoint
+        # folds into the dtt_serving_prefix_* counters.
+        self._sharing = cfg.prefix_sharing
+        self.sessions: dict[str, dict] = {}
+        self.prefix_stats = {"hit_tokens": 0, "saved_tokens": 0,
+                             "cow_pages": 0, "session_resumes": 0}
+        self._step_prefix = [0, 0]
+        # Prefill-compute accounting for the sharing win: prompt
+        # tokens actually pushed through a prefill program, and
+        # prefill program launches (a zero-prefill session re-attach
+        # must not move either).
+        self.prefill_tokens_computed = 0
+        self.prefill_launches = 0
+        self._cow_width = max(self.batch_local, self.prefill_local)
         # EVERY device->host sync in the serving hot path goes
         # through ``_fetch_host`` (pitfalls rule DTT010), so this
         # counter is exact — the bench asserts syncs <= tokens /
@@ -666,6 +745,8 @@ class Engine:
                 c, self.cfg, first=True, mesh=self.mesh)
             self._prefill_cont_fn = build_prefill_fn(
                 c, self.cfg, first=False, mesh=self.mesh)
+        if self._sharing:
+            self._cow_fn = build_cow_fn(c, self.cfg, mesh=self.mesh)
 
     def compile_counts(self) -> dict:
         """Jit-cache sizes per program — the bench's zero-recompile
@@ -679,6 +760,8 @@ class Engine:
                 self._prefill_first_fn._cache_size()
             counts["prefill_cont"] = \
                 self._prefill_cont_fn._cache_size()
+        if self._sharing:
+            counts["cow"] = self._cow_fn._cache_size()
         return counts
 
     def warmup(self) -> dict:
@@ -742,6 +825,15 @@ class Engine:
                                self.cache.v_pages, row, live, ctoks,
                                0, 1)
                 self.cache.update_pools(k, v)
+        if self._sharing:
+            # Scratch-to-scratch identity copies: compiles the COW
+            # program with zero allocator side effects.
+            W = self._cow_width
+            k, v = self._cow_fn(
+                self.cache.k_pages, self.cache.v_pages,
+                jnp.zeros((G, W), jnp.int32),
+                jnp.zeros((G, W), jnp.int32))
+            self.cache.update_pools(k, v)
         return self.compile_counts()
 
     # -- admission ---------------------------------------------------------
@@ -835,24 +927,214 @@ class Engine:
         return None
 
     def _admit(self) -> _Seq | None:
-        """Move the head-of-queue request into the least-loaded
-        group's free slot, pages for its FIRST chunk allocated. None
-        when no group has slot+pages (backpressure — the request
-        stays queued)."""
+        """Move the head-of-queue request into a free slot. With
+        prefix sharing the placement prefers the group holding the
+        LONGEST resident page-aligned prefix of the prompt (the new
+        sequence attaches those pages read-only and prefills only the
+        unmatched tail — a full cover prefills nothing); with no hit
+        anywhere it falls back to fewest-active-slots-first, exactly
+        the pre-sharing balancing. A session request whose retained
+        turn is resident resumes in ITS group (pages cannot cross a
+        pool shard) or waits for a slot there. None = backpressure —
+        the request stays queued."""
         if not self.queue:
             return None
         req = self.queue[0]
-        first = min(req.prompt.shape[0], self.cfg.prefill_chunk)
-        picked = self._pick_group(first)
-        if picked is None:
+        plen = int(req.prompt.shape[0])
+        first = min(plen, self.cfg.prefill_chunk)
+        if not self._sharing:
+            picked = self._pick_group(first)
+            if picked is None:
+                return None
+            group, slot = picked
+            self.queue.popleft()
+            self.cache.join(req.id, group=group)
+            self.cache.ensure(req.id, first)
+            seq = _Seq(req=req, slot=slot)
+            self.slots[slot] = seq
+            return seq
+        if req.session is not None and req.session in self.sessions:
+            res = self._try_resume(req)
+            if res is not None:
+                return None if res == "wait" else res
+            # retained turn diverged from this prompt — it was
+            # dropped; fall through to the normal path (the prefix
+            # index may still cover part of the prompt).
+        ps = self.cfg.page_size
+        active = self.slots_active_by_group()
+        order = sorted(range(self.dp_groups),
+                       key=lambda g: (active[g], g))
+        best = None      # (m, pages, group, slot), longest match wins
+        starved = None   # best candidate short on pages (sessions
+        for g in order:  # may be evictable — deferred to the pick)
+            slot = self._free_slot(g)
+            if slot is None:
+                continue
+            pages, m = self.cache.match_prefix(g, req.prompt)
+            if m * ps >= plen:
+                need = 1  # COW headroom for the boundary replay
+            elif m:
+                tgt = min(plen, m * ps + self.cfg.prefill_chunk)
+                need = -(-tgt // ps) - m
+            else:
+                need = -(-first // ps)
+            if need > self.cache.free_pages_in(g):
+                if starved is None or m > starved[0]:
+                    starved = (m, pages, g, slot, need)
+                continue
+            if best is None or m > best[0]:
+                best = (m, pages, g, slot)
+            if best[0] == 0:
+                break  # no hit and the balanced pick already found
+        if best is None and starved is not None:
+            # Every slot-holding group is short on pages; evict idle
+            # sessions (LRU) in the best starved group's shard before
+            # giving up — retained pages must never wedge admission.
+            # Re-match afterwards: the eviction may have freed the
+            # very pages the match pointed at.
+            m, pages, g, slot, need = starved
+            if self._evict_sessions(g, need):
+                pages, m = self.cache.match_prefix(g, req.prompt)
+                if m * ps >= plen or m or \
+                        self.cache.can_admit(first, group=g):
+                    best = (m, pages, g, slot)
+        if best is None:
             return None
-        group, slot = picked
+        m, pages, group, slot = best
         self.queue.popleft()
         self.cache.join(req.id, group=group)
-        self.cache.ensure(req.id, first)
         seq = _Seq(req=req, slot=slot)
+        if m * ps >= plen:
+            # Full page-aligned cover: ZERO prefill — attach all the
+            # pages at length plen - 1 and let the first decode
+            # replay the last prompt token (COW forks the boundary
+            # page; the sampled token is the prefill's first token).
+            self.cache.attach(req.id, pages, plen - 1)
+            seq.prefilled = plen
+            hit = plen
+        elif m:
+            self.cache.attach(req.id, pages, m * ps)
+            self.cache.ensure(
+                req.id, min(plen, m * ps + self.cfg.prefill_chunk))
+            seq.prefilled = m * ps
+            hit = m * ps
+        else:
+            self.cache.ensure(req.id, first)
+            hit = 0
+        if hit:
+            self.prefix_stats["hit_tokens"] += hit
+            self.prefix_stats["saved_tokens"] += hit
+            self._step_prefix[0] += hit
+            self._step_prefix[1] += hit
         self.slots[slot] = seq
         return seq
+
+    # -- prefix sharing / sessions -----------------------------------------
+
+    def _try_resume(self, req: Request):
+        """Re-attach a retained session turn. Returns the installed
+        ``_Seq``, ``"wait"`` (the session's group has no free slot —
+        stay queued; its pages live in ONE pool shard), or None (the
+        prompt diverged from the retained history, which was just
+        dropped)."""
+        key = req.session
+        sess = self.sessions[key]
+        hist = sess["history"]
+        hl = int(hist.shape[0])
+        prompt = np.array(req.prompt, np.int32)
+        plen = int(prompt.shape[0])
+        if hl > plen or not np.array_equal(prompt[:hl], hist):
+            self._drop_session(key)
+            return None
+        slot = self._free_slot(sess["group"])
+        if slot is None:
+            return "wait"
+        self.queue.popleft()
+        del self.sessions[key]
+        self.cache.rename(sess["cache_id"], req.id)
+        # Retained length is hl - 1 (the last generated token was
+        # sampled but its KV never written — decode's standard
+        # frontier). Exact match: prefilled = plen, zero prefill
+        # launches, decode replays prompt[-1]. Extended: the tail
+        # from position hl - 1 prefills as a continuation chunk.
+        exact = plen == hl
+        seq = _Seq(req=req, slot=slot,
+                   prefilled=plen if exact else hl - 1)
+        self.slots[slot] = seq
+        saved = plen if exact else hl - 1
+        self.prefix_stats["session_resumes"] += 1
+        self.prefix_stats["hit_tokens"] += saved
+        self.prefix_stats["saved_tokens"] += saved
+        self._step_prefix[0] += saved
+        self._step_prefix[1] += saved
+        return seq
+
+    def _drop_session(self, key: str) -> None:
+        sess = self.sessions.pop(key)
+        self.cache.free(sess["cache_id"])
+
+    def _evict_sessions(self, group: int, need: int) -> bool:
+        """Free retained sessions in ``group`` (LRU first) until
+        ``need`` pages are free. Returns True when satisfied.
+        Sessions sharing pages with live sequences release only
+        their unshared pages (refcounts protect the rest) — the loop
+        keeps evicting until the target is met or no session in the
+        group remains."""
+        while self.cache.free_pages_in(group) < need:
+            cands = sorted(
+                (s["t"], k) for k, s in self.sessions.items()
+                if s["group"] == group)
+            if not cands:
+                return False
+            self._drop_session(cands[0][1])
+        return True
+
+    def _cow_guard(self, seq_id) -> list | None:
+        """Privatize any shared page the next write into ``seq_id``
+        would touch. Returns the (src, dst) page pairs for
+        ``_apply_cow`` ([] = nothing shared), or None when the fork
+        stalled on free pages even after evicting an idle session
+        (the sequence skips this launch)."""
+        pairs = self.cache.privatize(seq_id)
+        if pairs is None:
+            self._evict_sessions(self.cache.group_of(seq_id), 1)
+            pairs = self.cache.privatize(seq_id)
+        return pairs
+
+    def _apply_cow(self, pairs: list) -> None:
+        """ONE fixed-shape launch copying every forked page:
+        ``pairs`` is [(group, src_page, dst_page)]. Unused lanes stay
+        (0 -> 0) scratch identities, so fork count never changes a
+        traced shape."""
+        import jax.numpy as jnp
+
+        G, W = self.dp_groups, self._cow_width
+        src = np.zeros((G, W), np.int32)
+        dst = np.zeros((G, W), np.int32)
+        fill = [0] * G
+        for g, a, b in pairs:
+            src[g, fill[g]] = a
+            dst[g, fill[g]] = b
+            fill[g] += 1
+        k, v = self._cow_fn(self.cache.k_pages, self.cache.v_pages,
+                            jnp.asarray(src), jnp.asarray(dst))
+        self.cache.update_pools(k, v)
+        self.prefix_stats["cow_pages"] += len(pairs)
+
+    def _register(self, seq: _Seq) -> None:
+        """Index the sequence's newly committed page-aligned
+        prefixes so later prompts can attach them. Skipped when the
+        pages are about to be freed anyway (finished, no session)."""
+        if not self._sharing:
+            return
+        if seq.done and seq.req.session is None:
+            return
+        if not self.cache.needs_register(seq.req.id):
+            return
+        self.cache.register_prefix(
+            seq.req.id,
+            np.concatenate([np.array(seq.req.prompt, np.int32),
+                            np.array(seq.generated, np.int32)]))
 
     # -- step --------------------------------------------------------------
 
@@ -883,6 +1165,7 @@ class Engine:
         self._step_spec = None
         self._step_resident = None
         self._last_prefill_lanes = None
+        self._step_prefix = [0, 0]
         syncs0 = self.host_syncs
         if kind == "prefill":
             if self.cfg.prefill_mode == "batched":
@@ -894,12 +1177,22 @@ class Engine:
                 tokens_out = self._run_prefill_batch(
                     self._prefill_candidates())
                 if tokens_out == 0:
-                    # Backpressure: every pending chunk stalled on
-                    # pages — decode so finishing sequences free
-                    # them (the r02 livelock fallback, batched).
+                    # Backpressure (every pending chunk stalled on
+                    # pages — the r02 livelock fallback) OR every
+                    # admission was a zero-prefill attach: decode.
+                    # Recompute decodable — a full prefix hit or an
+                    # exact session resume admits straight into the
+                    # decodable set.
+                    decodable = self._decode_candidates()
                     kind = "decode" if decodable else "idle"
             else:
                 seq = pending[0] if pending else self._admit()
+                if seq is not None and seq.prefill_done:
+                    # Zero-prefill admission (full prefix hit /
+                    # exact session resume): nothing to prefill —
+                    # the fresh slot decodes this very step.
+                    decodable = self._decode_candidates()
+                    kind = "decode" if decodable else "idle"
                 # Backpressure fallback: when admission OR a
                 # mid-prompt page allocation fails (pool exhausted),
                 # decode instead — decoding sequences finish and
@@ -907,7 +1200,7 @@ class Engine:
                 # the second fallback a prefill-priority engine
                 # livelocks (regression-pinned in
                 # tests/test_serving.py).
-                if seq is None or not self._run_prefill_chunk(seq):
+                elif seq is None or not self._run_prefill_chunk(seq):
                     kind = "decode" if decodable else "idle"
         if kind == "decode":
             tokens_out = self._run_decode(decodable)
@@ -931,6 +1224,17 @@ class Engine:
             mean_steps, _slots = self._step_resident
             rec["resident_k"] = self.cfg.resident_k
             rec["resident_steps_per_launch"] = mean_steps
+        if self._sharing:
+            # Additive sharing fields (schema pinned by test): the
+            # metrics observer accumulates the per-step deltas into
+            # the dtt_serving_prefix_* counters and folds the
+            # per-group shared-page list into a labeled family.
+            rec["prefix_hit_tokens"] = self._step_prefix[0]
+            rec["prefill_tokens_saved"] = self._step_prefix[1]
+            rec["sessions_resident"] = len(self.sessions)
+            rec["kv_pages_shared"] = [
+                self.cache.shared_pages_in(g)
+                for g in range(self.dp_groups)]
         syncs = self.host_syncs - syncs0
         rec["host_syncs"] = syncs
         if tokens_out:
@@ -979,6 +1283,13 @@ class Engine:
         n_valid = min(c.prefill_chunk, seq.prompt_len - start)
         if not self.cache.ensure(seq.req.id, start + n_valid):
             return False
+        if self._sharing:
+            pairs = self._cow_guard(seq.req.id)
+            if pairs is None:
+                return False  # fork stalled on pages — backpressure
+            if pairs:
+                g = self.cache.group_of(seq.req.id)
+                self._apply_cow([(g, a, b) for a, b in pairs])
         chunk = np.zeros((1, c.prefill_chunk), np.int32)
         chunk[0, :n_valid] = seq.req.prompt[start:start + n_valid]
         rows, live, g = self._group_row(seq.req.id)
@@ -993,6 +1304,8 @@ class Engine:
         self.cache.update_pools(k, v)
         self.cache.advance(seq.req.id, n_valid)
         seq.prefilled = start + n_valid
+        self.prefill_tokens_computed += n_valid
+        self.prefill_launches += 1
         if seq.prefill_done:
             # Slice ON DEVICE before the pull: one (V,) transfer per
             # completed prompt instead of the whole (G, V) block —
@@ -1009,7 +1322,10 @@ class Engine:
             if self.cfg.eos_id >= 0 and tok == self.cfg.eos_id:
                 seq.eos = True
             self._emit_token(seq, tok)
+            self._register(seq)
             self._maybe_finish(seq)
+            return True
+        self._register(seq)
         return True
 
     def _sample_host(self, logits) -> int:
@@ -1062,6 +1378,7 @@ class Engine:
         c = self.cfg
         G, Sp, C = self.dp_groups, self.prefill_local, c.prefill_chunk
         chosen: list[list[_Seq]] = [[] for _ in range(G)]
+        cow: list = []
         for s in pending:
             g = self.cache.group_of(s.req.id)
             if len(chosen[g]) >= Sp:
@@ -1069,9 +1386,16 @@ class Engine:
             n = min(C, s.prompt_len - s.prefilled)
             if not self.cache.ensure(s.req.id, s.prefilled + n):
                 continue  # this lane stalls; others still launch
+            if self._sharing:
+                pairs = self._cow_guard(s.req.id)
+                if pairs is None:
+                    continue  # lane stalls on fork pages
+                cow += [(g, a, b) for a, b in pairs]
             chosen[g].append(s)
         if not any(chosen):
             return 0
+        if cow:
+            self._apply_cow(cow)
         tokens = np.zeros((G, Sp, C), np.int32)
         start_pos = np.zeros((G, Sp), np.int32)
         n_valid = np.zeros((G, Sp), np.int32)
@@ -1094,6 +1418,7 @@ class Engine:
             self._rng_grouped(1_000_000 + self._step_counter))
         self.cache.update_pools(k, v)
         self._last_prefill_lanes = [len(seqs) for seqs in chosen]
+        self.prefill_launches += 1
         total = 0
         fetched = None
         now = None
@@ -1122,7 +1447,10 @@ class Engine:
                             tok == self.cfg.eos_id:
                         s.eos = True
                     self._emit_token(s, tok)
+                self._register(s)
+                if s.prefill_done:
                     self._maybe_finish(s)
+        self.prefill_tokens_computed += total
         return total
 
     def _draft(self, seq: _Seq, m: int) -> np.ndarray:
@@ -1164,6 +1492,7 @@ class Engine:
         active = np.zeros((G, B), bool)
         seq_ids: list[list] = [[None] * B for _ in range(G)]
         stepped: list[tuple[_Seq, int, np.ndarray]] = []
+        cow: list = []
         for s in decodable:
             length = self.cache.length(s.req.id)
             remaining = s.req.max_new_tokens - len(s.generated)
@@ -1180,8 +1509,13 @@ class Engine:
                     continue
                 n = 1
             g, i = divmod(s.slot, B)
+            if self._sharing:
+                pairs = self._cow_guard(s.req.id)
+                if pairs is None:
+                    continue  # fork stalled on pages; retry next step
+                cow += [(g, a, b) for a, b in pairs]
             draft = self._draft(s, n - 1)
-            tokens[g, i, 0] = s.generated[-1]
+            tokens[g, i, 0] = s.last_token
             if n > 1:
                 tokens[g, i, 1:n] = draft
             start_pos[g, i] = length
@@ -1191,6 +1525,8 @@ class Engine:
             stepped.append((s, n, draft))
         if not stepped:
             return 0
+        if cow:
+            self._apply_cow(cow)
         rows = self.cache.page_rows_grouped(seq_ids)
         out, k, v = self._decode_fn(
             self.params, self.cache.k_pages, self.cache.v_pages,
@@ -1229,6 +1565,7 @@ class Engine:
                 s.token_times.append(now)
                 self._emit_token(s, tok)
             total += len(emit)
+            self._register(s)
             self._maybe_finish(s)
         self._step_spec = (len(stepped), total)
         return total
@@ -1257,6 +1594,7 @@ class Engine:
         active = np.zeros((G, B), bool)
         seq_ids: list[list] = [[None] * B for _ in range(G)]
         stepped: list[_Seq] = []
+        cow: list = []
         for s in decodable:
             length = self.cache.length(s.req.id)
             remaining = s.req.max_new_tokens - len(s.generated)
@@ -1272,6 +1610,11 @@ class Engine:
             if not self.cache.ensure(s.req.id, length + want):
                 continue
             g, i = divmod(s.slot, B)
+            if self._sharing:
+                pairs = self._cow_guard(s.req.id)
+                if pairs is None:
+                    continue  # fork stalled on pages; retry next step
+                cow += [(g, a, b) for a, b in pairs]
             hist = np.concatenate([
                 np.array(s.req.prompt, np.int32),
                 np.array(s.generated, np.int32)])
@@ -1283,6 +1626,8 @@ class Engine:
             stepped.append(s)
         if not stepped:
             return 0
+        if cow:
+            self._apply_cow(cow)
         rows = self.cache.page_rows_grouped(seq_ids)
         out, n_emitted, steps, k, v = self._decode_fn(
             self.params, self.cache.k_pages, self.cache.v_pages,
@@ -1309,6 +1654,7 @@ class Engine:
                 s.token_times.append(now)
                 self._emit_token(s, tok)
             total += e
+            self._register(s)
             self._maybe_finish(s)
         g_steps = [int(steps[g]) for g in range(G)
                    if active[g].any()]
@@ -1332,6 +1678,7 @@ class Engine:
         active = np.zeros((G, B), bool)
         seq_ids: list[list] = [[None] * B for _ in range(G)]
         stepped: list[_Seq] = []
+        cow: list = []
         for s in decodable:
             # The new token's KV lands at position length(seq); make
             # sure a page covers it. Failure = that group's pool
@@ -1341,13 +1688,20 @@ class Engine:
                                      self.cache.length(s.req.id) + 1):
                 continue
             g, i = divmod(s.slot, B)
-            tokens[g, i] = s.generated[-1]
+            if self._sharing:
+                pairs = self._cow_guard(s.req.id)
+                if pairs is None:
+                    continue  # fork stalled on pages; retry next step
+                cow += [(g, a, b) for a, b in pairs]
+            tokens[g, i] = s.last_token
             positions[g, i] = self.cache.length(s.req.id)
             active[g, i] = True
             seq_ids[g][i] = s.req.id
             stepped.append(s)
         if not stepped:
             return 0
+        if cow:
+            self._apply_cow(cow)
         rows = self.cache.page_rows_grouped(seq_ids)
         rng = self._rng_grouped(self._step_counter)
         nxt, k, v = self._decode_fn(
@@ -1368,13 +1722,34 @@ class Engine:
                 s.first_token_t = now
             s.token_times.append(now)
             self._emit_token(s, tok)
+            self._register(s)
             self._maybe_finish(s)
         return len(stepped)
 
     def _maybe_finish(self, seq: _Seq) -> None:
         if not seq.done:
             return
-        self.cache.free(seq.req.id)
+        if self._sharing and seq.req.session is not None:
+            # Retain the turn's pages under the session key instead
+            # of freeing them: a follow-up request with this key
+            # re-attaches with zero prefill for the whole retained
+            # history. A stale earlier turn of the same key is
+            # superseded (its pages go back through the refcounted
+            # free).
+            key = seq.req.session
+            if key in self.sessions:
+                self._drop_session(key)
+            cid = f"~session:{key}"
+            self.cache.rename(seq.req.id, cid)
+            self.sessions[key] = {
+                "cache_id": cid,
+                "history": np.concatenate([
+                    np.array(seq.req.prompt, np.int32),
+                    np.array(seq.generated, np.int32)]),
+                "group": self.cache.group_of(cid),
+                "t": time.monotonic()}
+        else:
+            self.cache.free(seq.req.id)
         self.slots[seq.slot] = None
         now = time.monotonic()
         arrival = seq.req.arrival if seq.req.arrival is not None \
@@ -1489,6 +1864,7 @@ class Engine:
                     int(first_token) == self.cfg.eos_id:
                 seq.eos = True
             self._emit_token(seq, int(first_token))
+            self._register(seq)
             self._maybe_finish(seq)
 
     def preempt(self) -> list[Request]:
@@ -1499,7 +1875,13 @@ class Engine:
         engine is reusable afterwards (a restarted incarnation calls
         ``submit`` with these). Token listeners for the lost work are
         dropped too — a resubmitted request restarts from the prompt,
-        and a stale listener would stream its early tokens twice."""
+        and a stale listener would stream its early tokens twice.
+        RETAINED SESSIONS SURVIVE: their pages are refcount-held, so
+        freeing the in-flight sequences (some sharing those pages)
+        returns exactly the unshared pages — no leak, no double-free
+        — and a post-preemption resume still re-attaches with zero
+        prefill. Page content is untouched by the frees (a page is
+        never reused while held), so the retained KV stays valid."""
         lost: list[Request] = []
         for i, s in enumerate(self.slots):
             if s is None:

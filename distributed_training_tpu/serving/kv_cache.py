@@ -42,6 +42,25 @@ gauges. Invariant (pinned by test, per shard): for every group,
 sequence returns every group's occupancy to zero — no join/evict order
 can leak a page or let one group's allocation bleed into another's
 shard.
+
+**Sharing (SERVING_r05)**: pages are REFCOUNTED per (group, page).
+``attach`` lets a new sequence take read-only references on another
+sequence's committed pages (its table becomes a view of the shared
+prefix); ``free`` returns a page to the free list only when its LAST
+owner releases it, so the leak invariant extends unchanged — a page is
+"used" while any table holds it. A group-local PREFIX INDEX maps the
+exact bytes of each page-aligned token prefix to the page ids holding
+its KV (``register_prefix``/``match_prefix``); entries are registered
+only for FULLY COMMITTED pages (every slot written, so the content is
+immutable — later writes go through copy-on-write) and invalidated
+when their last page's refcount hits zero. ``privatize`` is the COW
+half: before a sequence writes into a page it shares (only the page at
+``length // page_size`` can qualify — committed pages below it are
+never written again), the shared page is swapped for a fresh private
+one and the caller performs the one batched device copy. ``rename``
+moves a table between owner keys without touching refcounts — the
+engine's session retention (a finished chat turn parks its pages under
+a session key for zero-prefill resume).
 """
 
 from __future__ import annotations
@@ -175,6 +194,22 @@ class PagedKVCache:
         self._tables: dict[object, list[int]] = {}
         self._lengths: dict[object, int] = {}
         self._groups: dict[object, int] = {}
+        # Sharing state, PER GROUP. ``_refs[g][page]`` counts the
+        # tables holding ``page`` (absent == on the free list);
+        # ``_index[g]`` maps the exact bytes of a page-aligned token
+        # prefix to the page ids holding its KV; ``_page_keys[g]``
+        # maps a page id to the index keys whose LAST page it is (a
+        # key dies exactly when its last page is released — earlier
+        # pages outlive it by the prefix-holding property, so one
+        # reverse entry per key suffices). ``_registered`` tracks how
+        # many of each sequence's pages are already in the index.
+        self._refs: list[dict[int, int]] = [
+            {} for _ in range(cfg.dp_groups)]
+        self._index: list[dict[bytes, tuple]] = [
+            {} for _ in range(cfg.dp_groups)]
+        self._page_keys: list[dict[int, set]] = [
+            {} for _ in range(cfg.dp_groups)]
+        self._registered: dict[object, int] = {}
 
     # -- allocator ---------------------------------------------------------
 
@@ -246,14 +281,18 @@ class PagedKVCache:
                 f"sequence {seq_id!r} needs {n_tokens} positions, "
                 f"pool max_seq_len is {self.cfg.max_seq_len}")
         table = self._tables[seq_id]
-        free = self._frees[self._groups[seq_id]]
+        group = self._groups[seq_id]
+        free = self._frees[group]
         need = -(-n_tokens // self.cfg.page_size) - len(table)
         if need <= 0:
             return True
         if need > len(free):
             return False
+        refs = self._refs[group]
         for _ in range(need):
-            table.append(free.pop())
+            page = free.pop()
+            refs[page] = 1
+            table.append(page)
         self._emit("grow", seq_id)
         return True
 
@@ -270,18 +309,154 @@ class PagedKVCache:
         self._lengths[seq_id] = new_len
 
     def free(self, seq_id) -> int:
-        """Evict: return the sequence's pages to its group's free
-        list. Returns the page count released."""
+        """Evict: drop one reference on each of the sequence's pages;
+        pages whose LAST reference this was go back to the group's
+        free list (and their prefix-index entries die with them).
+        Returns the page count actually released."""
         table = self._tables.pop(seq_id)
         del self._lengths[seq_id]
         group = self._groups[seq_id]
-        self._frees[group].extend(reversed(table))
+        refs = self._refs[group]
+        released = []
+        for page in table:
+            refs[page] -= 1
+            if refs[page] == 0:
+                del refs[page]
+                self._invalidate(group, page)
+                released.append(page)
+        self._frees[group].extend(reversed(released))
+        self._registered.pop(seq_id, None)
         self._emit("free", seq_id)
         del self._groups[seq_id]
-        return len(table)
+        return len(released)
 
     def length(self, seq_id) -> int:
         return self._lengths[seq_id]
+
+    # -- sharing: refcounted attach / COW / prefix index -------------------
+
+    def attach(self, seq_id, pages, n_tokens: int) -> None:
+        """Take read-only references on ``pages`` (an existing
+        resident prefix, in table order) for a JOINED sequence with an
+        EMPTY table, and mark ``n_tokens`` positions as already
+        written. The pages must be live in the sequence's group —
+        attaching a freed page is a hard error, not a silent
+        corruption."""
+        table = self._tables[seq_id]
+        if table or self._lengths[seq_id]:
+            raise RuntimeError(
+                f"sequence {seq_id!r} already has pages — attach is "
+                "admission-time only")
+        if n_tokens > len(pages) * self.cfg.page_size:
+            raise ValueError(
+                f"sequence {seq_id!r}: attaching {len(pages)} page(s) "
+                f"cannot cover {n_tokens} positions")
+        refs = self._refs[self._groups[seq_id]]
+        for page in pages:
+            refs[page] = refs[page] + 1  # KeyError if not live
+        table.extend(pages)
+        self._lengths[seq_id] = n_tokens
+        # The attached prefix is already indexed (it came FROM the
+        # index or a session table) — start registration past it.
+        self._registered[seq_id] = len(pages)
+        self._emit("attach", seq_id)
+
+    def rename(self, old_id, new_id) -> None:
+        """Move a table between owner keys (refcounts untouched) —
+        session retention parks a finished sequence's pages under its
+        session key; resume renames them back."""
+        if new_id in self._tables:
+            raise KeyError(f"sequence {new_id!r} already joined")
+        self._tables[new_id] = self._tables.pop(old_id)
+        self._lengths[new_id] = self._lengths.pop(old_id)
+        self._groups[new_id] = self._groups.pop(old_id)
+        if old_id in self._registered:
+            self._registered[new_id] = self._registered.pop(old_id)
+
+    def privatize(self, seq_id):
+        """Copy-on-write bookkeeping: swap every SHARED page at or
+        past the sequence's write frontier (``length // page_size``)
+        for a fresh private page. Returns the ``(src, dst)`` page-id
+        pairs for the caller's batched device copy ([] when nothing
+        was shared), or None — allocating nothing — when the free list
+        cannot cover the swap (backpressure, same contract as
+        ``ensure``). Only the frontier page can be both shared and
+        written (pages below it are fully committed and never written
+        again), so this is at most one pair per call in practice; the
+        loop keeps the invariant rather than assuming it."""
+        table = self._tables[seq_id]
+        group = self._groups[seq_id]
+        refs = self._refs[group]
+        free = self._frees[group]
+        start = self._lengths[seq_id] // self.cfg.page_size
+        idxs = [i for i in range(start, len(table))
+                if refs[table[i]] > 1]
+        if len(idxs) > len(free):
+            return None
+        pairs = []
+        for i in idxs:
+            src = table[i]
+            dst = free.pop()
+            refs[src] -= 1
+            refs[dst] = 1
+            table[i] = dst
+            pairs.append((src, dst))
+        if pairs:
+            # Our claim on any index entries ending at src moved with
+            # the fork: keep registration honest by clamping what this
+            # sequence counts as registered below the forked page.
+            if self._registered.get(seq_id, 0) > idxs[0]:
+                self._registered[seq_id] = idxs[0]
+            self._emit("cow", seq_id)
+        return pairs
+
+    def register_prefix(self, seq_id, tokens) -> None:
+        """Index every fully-committed page-aligned prefix of
+        ``tokens`` (the sequence's token history) not yet registered.
+        Keyed by the EXACT prefix bytes — matching is equality, not a
+        lossy hash, so a hit can never alias two different prompts."""
+        table = self._tables[seq_id]
+        group = self._groups[seq_id]
+        ps = self.cfg.page_size
+        full = self._lengths[seq_id] // ps
+        done = self._registered.get(seq_id, 0)
+        if full <= done:
+            return
+        toks = np.array(tokens, np.int32)
+        for j in range(done + 1, full + 1):
+            key = toks[:j * ps].tobytes()
+            self._index[group][key] = tuple(table[:j])
+            self._page_keys[group].setdefault(
+                table[j - 1], set()).add(key)
+        self._registered[seq_id] = full
+
+    def needs_register(self, seq_id) -> bool:
+        """Does the sequence have committed pages not yet indexed?"""
+        return (self._lengths[seq_id] // self.cfg.page_size
+                > self._registered.get(seq_id, 0))
+
+    def match_prefix(self, group: int, tokens):
+        """Longest indexed page-aligned prefix of ``tokens`` resident
+        in ``group``: returns ``(pages, n_pages)`` or ``((), 0)``."""
+        index = self._index[group]
+        if not index:
+            return (), 0
+        toks = np.array(tokens, np.int32)
+        ps = self.cfg.page_size
+        for j in range(len(toks) // ps, 0, -1):
+            pages = index.get(toks[:j * ps].tobytes())
+            if pages is not None:
+                return pages, j
+        return (), 0
+
+    def _invalidate(self, group: int, page: int) -> None:
+        """Drop the index entries whose last page just died."""
+        for key in self._page_keys[group].pop(page, ()):
+            self._index[group].pop(key, None)
+
+    def shared_pages_in(self, group: int) -> int:
+        """Pages in ``group`` held by more than one table."""
+        return sum(1 for n in self._refs[group].values() if n > 1)
 
     def token_capacity(self, seq_id) -> int:
         """Max TOTAL positions this sequence could hold right now:

@@ -87,7 +87,7 @@ class ServingServer:
         while not self._stop.is_set():
             with self._lock:
                 incoming, self._mailbox = self._mailbox, []
-            for rid, prompt, n, arrival in incoming:
+            for rid, prompt, n, arrival, session in incoming:
                 with self._lock:
                     stream_q = self._streams.get(rid)
                 if stream_q is not None:
@@ -100,7 +100,8 @@ class ServingServer:
                 try:
                     eng.submit(Request(id=rid, prompt=prompt,
                                        max_new_tokens=n,
-                                       arrival=arrival))
+                                       arrival=arrival,
+                                       session=session))
                 except ValueError as e:
                     # An invalid request answers ITS caller; it must
                     # never take down the engine thread (and with it
@@ -133,9 +134,13 @@ class ServingServer:
                 eng.completed.clear()
 
     def generate(self, prompt: np.ndarray, max_new_tokens: int,
-                 timeout: float = 120.0) -> dict:
+                 timeout: float = 120.0,
+                 session: str | None = None) -> dict:
         """Enqueue + wait (the HTTP handler path; also the in-process
-        API tests use)."""
+        API tests use). ``session``: chat-session key — the engine
+        retains the turn's KV pages under it and a follow-up call
+        with the same key resumes with zero prefill for the retained
+        history (serving/engine.py)."""
         arrival = time.monotonic()
         ev = threading.Event()
         with self._lock:
@@ -143,7 +148,8 @@ class ServingServer:
             self._next_id += 1
             self._events[rid] = ev
             self._mailbox.append((rid, np.array(prompt, np.int32),
-                                  int(max_new_tokens), arrival))
+                                  int(max_new_tokens), arrival,
+                                  session))
         if not ev.wait(timeout):
             with self._lock:
                 # Deregister so a late completion is dropped instead
@@ -156,7 +162,8 @@ class ServingServer:
 
     def generate_stream(self, prompt: np.ndarray,
                         max_new_tokens: int,
-                        timeout: float = 120.0):
+                        timeout: float = 120.0,
+                        session: str | None = None):
         """Enqueue + yield per-token dicts as the engine produces
         them: ``{"token": N}`` per sampled token, then a final
         ``{"done": True, "tokens", "ttft_s", "latency_s"}``. The
@@ -169,7 +176,8 @@ class ServingServer:
             self._next_id += 1
             self._streams[rid] = q
             self._mailbox.append((rid, np.array(prompt, np.int32),
-                                  int(max_new_tokens), arrival))
+                                  int(max_new_tokens), arrival,
+                                  session))
         deadline = time.monotonic() + timeout
         try:
             while True:
@@ -237,11 +245,14 @@ class ServingServer:
             raise ValueError(
                 f"prompt ({ids.size}) + max_new_tokens ({n}) must "
                 f"fit max_seq_len ({limit})")
-        return ids, n
+        session = body.get("session")
+        if session is not None and not isinstance(session, str):
+            raise ValueError("'session' must be a string key")
+        return ids, n, session
 
     def _handle_generate(self, body: dict) -> dict:
-        ids, n = self._parse_generate(body)
-        rec = self.generate(ids, n)
+        ids, n, session = self._parse_generate(body)
+        rec = self.generate(ids, n, session=session)
         if "error" in rec:
             raise ValueError(rec["error"])
         out = {"tokens": rec["tokens"], "ttft_s": rec["ttft_s"],
@@ -281,11 +292,12 @@ class ServingServer:
 
             def _stream_generate(self, body: dict) -> None:
                 try:
-                    ids, n = server._parse_generate(body)
+                    ids, n, session = server._parse_generate(body)
                 except (ValueError, KeyError) as e:
                     self._reply(400, {"error": str(e)})
                     return
-                gen = server.generate_stream(ids, n)
+                gen = server.generate_stream(ids, n,
+                                             session=session)
                 self.send_response(200)
                 self.send_header("Content-Type",
                                  "application/jsonl")
@@ -414,6 +426,11 @@ def engine_config_from_yaml(plan, engine_block: dict):
                      "prefill_slots", "prefill_mode", "spec_k",
                      "spec_ngram", "resident_k", "eos_id")
             and v not in (0, 0.0, None, "")}
+    # prefix_sharing is a REAL boolean: False == 0 would fall into
+    # the "keep default" filter above and silently re-enable it.
+    if "prefix_sharing" in engine_block \
+            and engine_block["prefix_sharing"] is not None:
+        over["prefix_sharing"] = bool(engine_block["prefix_sharing"])
     return dataclasses.replace(base, **over)
 
 

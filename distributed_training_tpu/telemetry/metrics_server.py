@@ -220,13 +220,30 @@ class MetricsServer:
                          "serving_group_prefill_slots_active"),
                         ("group_pages_used",
                          "serving_group_kv_pages_used"),
-                        ("group_seqs", "serving_group_seqs")):
+                        ("group_seqs", "serving_group_seqs"),
+                        ("kv_pages_shared",
+                         "serving_kv_pages_shared")):
                     vals = rec.get(src)
                     if isinstance(vals, (list, tuple)):
                         fam = self._labeled.setdefault(dst, {})
                         for g, v in enumerate(vals):
                             if isinstance(v, (int, float)):
                                 fam[f'group="{g}"'] = float(v)
+                # Prefix-sharing counters + session gauge (SERVING_r05
+                # step records are additive: sharing-disabled engines
+                # simply omit these keys).
+                for src, dst in (
+                        ("prefix_hit_tokens",
+                         "serving_prefix_hit_tokens_total"),
+                        ("prefill_tokens_saved",
+                         "serving_prefill_tokens_saved_total")):
+                    if isinstance(rec.get(src), (int, float)):
+                        self._counters[dst] = \
+                            self._counters.get(dst, 0.0) + rec[src]
+                if isinstance(rec.get("sessions_resident"),
+                              (int, float)):
+                    self._gauges["serving_sessions_resident"] = \
+                        float(rec["sessions_resident"])
             elif kind == "serving_kv":
                 # Allocator records: keep occupancy live even between
                 # engine steps (join/evict happen inside steps, but
@@ -341,6 +358,18 @@ class MetricsServer:
         "serving_group_kv_pages_used": "KV pages allocated in each "
                                        "dp group's pool shard",
         "serving_group_seqs": "Sequences resident per dp group",
+        "serving_kv_pages_shared": "KV pages with refcount > 1 per "
+                                   "dp group (prefix sharing)",
+        "serving_prefix_hit_tokens_total": "Prompt tokens served from "
+                                           "the prefix index instead "
+                                           "of being prefilled",
+        "serving_prefill_tokens_saved_total": "Prefill compute "
+                                              "avoided by prefix "
+                                              "sharing and session "
+                                              "resume (tokens)",
+        "serving_sessions_resident": "Retained chat sessions holding "
+                                     "KV pages for zero-prefill "
+                                     "resume",
     }
 
     def render(self) -> str:

@@ -379,6 +379,12 @@ SERVING_GAUGES = (
     # steps-per-launch gauge additionally needs resident_k > 1).
     "dtt_serving_host_syncs_per_token",
     "dtt_serving_weight_bytes",
+    # SERVING_r05 additions (prefix sharing is on by default, so
+    # every engine step carries them; the counters render with the
+    # same `name value` shape as gauges).
+    "dtt_serving_sessions_resident",
+    "dtt_serving_prefix_hit_tokens_total",
+    "dtt_serving_prefill_tokens_saved_total",
 )
 
 
@@ -407,6 +413,9 @@ def test_metrics_endpoint_serving_gauge_schema(tiny_model, tmp_path):
         for gauge in SERVING_GAUGES:
             assert f"\n{gauge} " in "\n" + body, \
                 f"{gauge} missing from /metrics"
+        # Per-group shared-page family (labeled, so the bare-name
+        # pattern above does not cover it).
+        assert 'dtt_serving_kv_pages_shared{group="0"}' in body
         assert "dtt_serving_requests_total 1" in body
         # Additive: the training schema is still there.
         assert "dtt_up 1" in body
@@ -1762,3 +1771,377 @@ def test_serving_ledger_committed_and_coherent():
     assert 0 < pre["goodput"] <= 1
     assert pre["tokens_match_steady_storm"] is True
     assert doc["plan"]["name"] == "serving_8dev_cpu_decode"
+
+
+# ---------------------------------------------------------------------------
+# prefix sharing: refcounted COW pages, prefix index, sessions (r05)
+# ---------------------------------------------------------------------------
+
+
+def test_refcount_invariants_random_join_fork_retain_evict_free():
+    """The PR-13 leak invariant extended to REFCOUNTS: any order of
+    join / grow / fork (attach) / retain (rename) / free keeps every
+    group's distinct-allocated + free == usable exact, a shared page
+    survives until its LAST owner releases it, allocations never
+    bleed across shards, and a full drain returns every group to
+    zero — no leak, no double-free."""
+    from distributed_training_tpu.serving.kv_cache import (
+        PagedCacheConfig, PagedKVCache)
+
+    G = 3
+    cfg = PagedCacheConfig(n_layers=2, n_kv_heads=2, head_dim=16,
+                           page_size=8, num_pages=24, max_seq_len=96,
+                           dp_groups=G)
+    cache = PagedKVCache(cfg)
+    rng = np.random.default_rng(31)
+    live: dict = {}   # key -> (group, n_tokens)
+    next_id = 0
+    for _ in range(800):
+        # Invariant sweep: what the cache thinks is allocated per
+        # group must equal the union of live tables (forked pages
+        # counted ONCE), and the free list must cover the rest.
+        for g in range(G):
+            union = set()
+            for key, (kg, _n) in live.items():
+                if kg == g:
+                    union.update(cache._tables[key])
+            assert cache.pages_used_in(g) == len(union)
+            assert cache.pages_used_in(g) + \
+                cache.free_pages_in(g) == cfg.usable_pages
+            # No cross-shard bleed: refcounted pages in g are
+            # exactly the allocated ones.
+            assert set(cache._refs[g]) == union
+        op = int(rng.integers(0, 5))
+        if op == 0 and len(live) < 10:
+            g = int(rng.integers(0, G))
+            cache.join(next_id, group=g)
+            live[next_id] = (g, 0)
+            next_id += 1
+        elif op == 1 and live:
+            key = list(live)[int(rng.integers(0, len(live)))]
+            g, n = live[key]
+            want = min(n + int(rng.integers(1, 20)),
+                       cfg.max_seq_len)
+            if cache.ensure(key, want):
+                cache.advance(key, want - n)
+                live[key] = (g, want)
+        elif op == 2 and live:
+            # Fork: attach a committed page-aligned prefix of a live
+            # sequence to a fresh one (refcounts go up, no pages
+            # move).
+            donors = [k for k, (_g, n) in live.items()
+                      if n >= cfg.page_size]
+            if donors:
+                donor = donors[int(rng.integers(0, len(donors)))]
+                g, n = live[donor]
+                j = int(rng.integers(1, n // cfg.page_size + 1))
+                cache.join(next_id, group=g)
+                cache.attach(next_id,
+                             tuple(cache._tables[donor][:j]),
+                             j * cfg.page_size)
+                live[next_id] = (g, j * cfg.page_size)
+                next_id += 1
+        elif op == 3 and live:
+            # Retain: park a sequence under a session-style key —
+            # pages survive the identity change untouched.
+            key = list(live)[int(rng.integers(0, len(live)))]
+            if not (isinstance(key, tuple) and key[0] == "sess"):
+                cache.rename(key, ("sess", key))
+                live[("sess", key)] = live.pop(key)
+        elif op == 4 and live:
+            key = list(live)[int(rng.integers(0, len(live)))]
+            cache.free(key)
+            del live[key]
+    for key in list(live):
+        cache.free(key)
+    assert cache.pages_used == 0
+    for g in range(G):
+        assert cache.free_pages_in(g) == cfg.usable_pages
+        assert not cache._refs[g]
+        assert cache.shared_pages_in(g) == 0
+
+
+def test_prefix_index_is_dp_group_local():
+    """No cross-group sharing: a prefix registered in group 0 never
+    matches admission into group 1 (each dp shard's pool is its own
+    physical memory — a cross-group page id would read another
+    shard's bytes)."""
+    from distributed_training_tpu.serving.kv_cache import (
+        PagedCacheConfig, PagedKVCache)
+
+    cfg = PagedCacheConfig(n_layers=2, n_kv_heads=2, head_dim=16,
+                           page_size=8, num_pages=16, max_seq_len=64,
+                           dp_groups=2)
+    cache = PagedKVCache(cfg)
+    toks = np.arange(16, dtype=np.int32)
+    cache.join("a", group=0)
+    assert cache.ensure("a", 16)
+    cache.advance("a", 16)
+    cache.register_prefix("a", toks)
+    pages, m = cache.match_prefix(0, toks)
+    assert m == 2 and len(pages) == 2
+    assert cache.match_prefix(1, toks) == ((), 0)
+    # Sub-page prefixes are never indexed either (page-alignment
+    # rule): 7 of the same leading tokens match nothing.
+    assert cache.match_prefix(0, toks[:7]) == ((), 0)
+    cache.free("a")
+    # Freeing the last owner invalidates the index entries.
+    assert cache.match_prefix(0, toks) == ((), 0)
+    assert cache.pages_used == 0
+
+
+def test_cow_fork_token_parity_diverging_mid_page(tiny_model):
+    """Two requests share a prompt header and diverge MID-PAGE: the
+    follower attaches the shared full pages, prefills only its tail,
+    and both streams are token-identical to fully independent
+    prefill (the full-context reference). The page-aligned twin then
+    pins the actual copy-on-write: a full-prefix match admits with
+    zero prefill tokens and forks the shared boundary page on its
+    first decode write."""
+    model, params = tiny_model
+    eng = _engine(model, params)
+    eng.warmup()
+    rng = np.random.default_rng(47)
+    common = rng.integers(0, 256, size=12).astype(np.int32)
+    pa = np.concatenate(
+        [common, rng.integers(0, 256, size=4).astype(np.int32)])
+    pb = np.concatenate(
+        [common, rng.integers(0, 256, size=4).astype(np.int32)])
+    eng.submit(Request(id="a", prompt=pa, max_new_tokens=6))
+    for _ in range(3):   # prefill a fully (registers its pages)
+        eng.step()
+    pt0 = eng.prefill_tokens_computed
+    eng.submit(Request(id="b", prompt=pb, max_new_tokens=6))
+    eng.run_until_drained()
+    done = {r["id"]: r["tokens"] for r in eng.completed}
+    assert done["a"] == _full_context_greedy(model, params, pa, 6)
+    assert done["b"] == _full_context_greedy(model, params, pb, 6)
+    # b shared common's one full page (8 of 12 tokens) and computed
+    # only the 8 uncovered ones.
+    assert eng.prefix_stats["hit_tokens"] >= 8
+    assert eng.prefill_tokens_computed - pt0 == len(pb) - 8
+    # Page-aligned twin: full match, zero prefill, COW on write.
+    p16 = rng.integers(0, 256, size=16).astype(np.int32)
+    eng.submit(Request(id="x", prompt=p16, max_new_tokens=10))
+    for _ in range(4):
+        eng.step()
+    pt0 = eng.prefill_tokens_computed
+    eng.submit(Request(id="y", prompt=p16.copy(),
+                       max_new_tokens=4))
+    eng.run_until_drained()
+    done = {r["id"]: r["tokens"] for r in eng.completed}
+    assert eng.prefill_tokens_computed == pt0
+    assert eng.prefix_stats["cow_pages"] >= 1
+    assert done["y"] == _full_context_greedy(model, params, p16, 4)
+    assert done["x"] == _full_context_greedy(model, params, p16, 10)
+    # Sharing is bookkeeping only: everything drains back to zero.
+    assert eng.cache.pages_used == 0
+
+
+def test_session_reattach_zero_prefill_parity(tiny_model):
+    """Chat sessions: the first turn retains its pages under the
+    session key; an EXACT follow-up (prompt == retained history)
+    re-attaches with ZERO prefill launches, an extended follow-up
+    prefills only the unseen suffix — both token-identical to the
+    full-context reference."""
+    model, params = tiny_model
+    eng = _engine(model, params)
+    eng.warmup()
+    rng = np.random.default_rng(53)
+    p1 = rng.integers(0, 256, size=12).astype(np.int32)
+    eng.submit(Request(id="t1", prompt=p1, max_new_tokens=4,
+                       session="s"))
+    eng.run_until_drained()
+    t1 = next(r for r in eng.completed if r["id"] == "t1")["tokens"]
+    assert len(eng.sessions) == 1
+    assert eng.cache.pages_used > 0   # retained, not freed
+    hist = np.concatenate([p1, np.asarray(t1, np.int32)])
+    pl0, pt0 = eng.prefill_launches, eng.prefill_tokens_computed
+    eng.submit(Request(id="t2", prompt=hist, max_new_tokens=4,
+                       session="s"))
+    eng.run_until_drained()
+    t2 = next(r for r in eng.completed if r["id"] == "t2")["tokens"]
+    assert eng.prefill_launches == pl0, \
+        "exact resume must not launch a prefill program"
+    assert eng.prefill_tokens_computed == pt0
+    assert t2 == _full_context_greedy(model, params, hist, 4)
+    # Extended turn: history + new user tokens → prefill only those.
+    hist2 = np.concatenate(
+        [hist, np.asarray(t2, np.int32),
+         rng.integers(0, 256, size=3).astype(np.int32)])
+    eng.submit(Request(id="t3", prompt=hist2, max_new_tokens=4,
+                       session="s"))
+    eng.run_until_drained()
+    t3 = next(r for r in eng.completed if r["id"] == "t3")["tokens"]
+    assert t3 == _full_context_greedy(model, params, hist2, 4)
+    assert eng.prefix_stats["session_resumes"] == 2
+    assert len(eng.sessions) == 1
+    # A mismatched prompt DROPS the stale session and prefills from
+    # scratch (no silent wrong-context reuse).
+    other = rng.integers(0, 256, size=6).astype(np.int32)
+    eng.submit(Request(id="t4", prompt=other, max_new_tokens=2,
+                       session="s"))
+    eng.run_until_drained()
+    t4 = next(r for r in eng.completed if r["id"] == "t4")["tokens"]
+    assert t4 == _full_context_greedy(model, params, other, 2)
+    eng._drop_session("s")
+    assert eng.cache.pages_used == 0
+
+
+def test_subpage_prefix_never_shares(tiny_model):
+    """Page-alignment edge: prompts shorter than one page are never
+    indexed, so an identical sub-page prompt admits with zero hits
+    (sharing granularity is the page, by design)."""
+    model, params = tiny_model
+    eng = _engine(model, params)
+    eng.warmup()
+    rng = np.random.default_rng(59)
+    p6 = rng.integers(0, 256, size=6).astype(np.int32)
+    eng.submit(Request(id="m1", prompt=p6, max_new_tokens=3,
+                       session="keep"))
+    eng.run_until_drained()
+    eng.submit(Request(id="m2", prompt=p6.copy(),
+                       max_new_tokens=3))
+    eng.run_until_drained()
+    assert eng.prefix_stats["hit_tokens"] == 0
+    m1 = next(r for r in eng.completed if r["id"] == "m1")["tokens"]
+    m2 = next(r for r in eng.completed if r["id"] == "m2")["tokens"]
+    assert m1 == m2 == _full_context_greedy(model, params, p6, 3)
+
+
+def test_preempt_keeps_sessions_skippable_and_free_list_clean(
+        tiny_model):
+    """Eviction policy: preempt() drops in-flight work but RETAINED
+    sessions survive (their pages are refcount-held, not slot-held),
+    the free list stays exact, and the next incarnation both replays
+    the lost requests token-identically and zero-prefill-resumes the
+    session."""
+    model, params = tiny_model
+    eng = _engine(model, params)
+    eng.warmup()
+    rng = np.random.default_rng(61)
+    p1 = rng.integers(0, 256, size=12).astype(np.int32)
+    eng.submit(Request(id="t1", prompt=p1, max_new_tokens=4,
+                       session="s"))
+    eng.run_until_drained()
+    t1 = next(r for r in eng.completed if r["id"] == "t1")["tokens"]
+    held = eng.cache.pages_used
+    assert held > 0
+    prompts = {f"r{i}": rng.integers(0, 256, size=10).astype(
+        np.int32) for i in range(3)}
+    for rid, p in prompts.items():
+        eng.submit(Request(id=rid, prompt=p, max_new_tokens=5))
+    eng.step()
+    eng.step()
+    lost = eng.preempt()
+    assert {r.id for r in lost} == set(prompts)
+    # Sessions survive preemption; in-flight pages all released.
+    assert len(eng.sessions) == 1
+    assert eng.cache.pages_used == held
+    g = eng.sessions["s"]["group"]
+    assert eng.cache.pages_used_in(g) + eng.cache.free_pages_in(g) \
+        == eng.cache.cfg.usable_pages
+    for r in lost:
+        eng.submit(r)
+    eng.run_until_drained()
+    for rid, p in prompts.items():
+        got = next(r for r in eng.completed
+                   if r["id"] == rid)["tokens"]
+        assert got == _full_context_greedy(model, params, p, 5)
+    # The retained session still resumes with zero prefill.
+    hist = np.concatenate([p1, np.asarray(t1, np.int32)])
+    pl0 = eng.prefill_launches
+    eng.submit(Request(id="t2", prompt=hist, max_new_tokens=2,
+                       session="s"))
+    eng.run_until_drained()
+    assert eng.prefill_launches == pl0
+    t2 = next(r for r in eng.completed if r["id"] == "t2")["tokens"]
+    assert t2 == _full_context_greedy(model, params, hist, 2)
+    eng._drop_session("s")
+    assert eng.cache.pages_used == 0
+
+
+def test_int8_plan_spends_hbm_credit_on_kv_pool():
+    """ROADMAP item 4 remainder: the committed int8 plan's provenance
+    prices the residual HBM credit as KV pages (kv_pool_tokens >
+    the minimal slots×seq_len pool) and the engine geometry actually
+    spends it — a BIGGER per-group pool than the fp32 plan's minimal
+    sizing, same program shapes otherwise."""
+    from distributed_training_tpu.parallel.planner import load_plan
+    from distributed_training_tpu.serving.disagg import (
+        engine_config_for_plan)
+
+    plan = load_plan("serving_8dev_cpu_decode_int8")
+    score = plan.provenance["score"]
+    assert score["kv_pool_tokens"] >= \
+        plan.batch_per_shard * plan.seq_len
+    assert score["kv_pool_tokens"] == score["kv_capacity_tokens"]
+    assert score["kv_pool_gib_delta"] > 0
+    cfg_q = engine_config_for_plan(plan)
+    dp = plan.mesh.get("dp", 1)
+    minimal = (plan.batch_per_shard // dp) \
+        * -(-plan.seq_len // cfg_q.page_size) + 1
+    assert cfg_q.num_pages > minimal
+    # Plans without the provenance field keep the minimal pool —
+    # pre-r05 plan files stay valid.
+    base = load_plan("serving_8dev_cpu_decode")
+    cfg_b = engine_config_for_plan(base)
+    dp_b = base.mesh.get("dp", 1)
+    assert cfg_b.num_pages == (base.batch_per_shard // dp_b) \
+        * -(-base.seq_len // cfg_b.page_size) + 1
+
+
+def test_serving_r05_ledger_committed_and_coherent():
+    """SERVING_r05.json: the prefix-sharing acceptance gates stay
+    machine-checked — ≥4x fewer prefill tokens computed than the
+    sharing-disabled same-run engine, byte-identical streams, zero
+    recompiles, a zero-prefill-launch session re-attach, and the
+    saturated-decode non-regression vs the committed r04 entry."""
+    import os
+
+    root = os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))
+    with open(os.path.join(root, "SERVING_r05.json")) as f:
+        doc = json.load(f)
+    with open(os.path.join(root, "SERVING_r04.json")) as f:
+        r04 = json.load(f)
+    assert doc["revision"] == "r05"
+    steady = doc["steady"]
+    assert steady["recompiles_after_warmup"] == 0
+    assert steady["greedy_matches_full_context"] is True
+    pre = doc["prefix"]
+    assert pre["recompiles_after_warmup"] == 0
+    assert pre["tokens_match_sharing_disabled"] is True
+    assert pre["greedy_matches_full_context"] is True
+    cmp_pre = pre["compared_to"]
+    assert cmp_pre["reduction_x"] >= 4.0
+    assert cmp_pre["prefill_tokens_computed"] >= \
+        4 * pre["prefill_tokens_computed"]
+    followers = pre["tenants"] - pre["primer_waves"]
+    assert pre["prefix_hit_tokens"] >= \
+        followers * pre["common_prefix_tokens"]
+    assert pre["prefill_tokens_saved"] >= \
+        followers * pre["common_prefix_tokens"]
+    fork = pre["zero_prefill_fork"]
+    assert fork["prefill_tokens_computed"] == 0
+    assert fork["cow_pages"] >= 1
+    assert fork["tokens_match_retained_twin"] is True
+    ses = doc["session"]
+    assert ses["zero_prefill_resume"] is True
+    assert ses["resume_exact"]["prefill_launches"] == 0
+    assert ses["resume_exact"]["prefill_tokens_computed"] == 0
+    assert ses["resume_extended"]["prefill_tokens_computed"] <= \
+        ses["resume_extended"]["prompt_tokens"] \
+        - ses["resume_exact"]["prompt_tokens"] \
+        - ses["resume_exact"]["new_tokens"] + 1
+    assert ses["session_resumes"] >= 2
+    assert ses["tokens_match_full_context"] is True
+    cmp_block = doc["compared_to"]
+    assert cmp_block["revision"] == "r04"
+    assert cmp_block["tokens_per_s"] == \
+        r04["saturated"]["tokens_per_s"]
+    assert doc["saturated"]["tokens_per_s"] >= \
+        0.75 * r04["saturated"]["tokens_per_s"]
+    # The r04 lanes all still ride the r05 entry.
+    assert doc["int8"]["argmax_parity"] is True
+    assert doc["preemption"]["tokens_match_steady_storm"] is True
