@@ -491,13 +491,14 @@ class DisaggPipeline:
             mesh=self.decode_mesh)
 
     def generate(self, prompt: np.ndarray, max_new_tokens: int,
-                 req_id: str = "disagg") -> list[int]:
+                 req_id: str = "disagg",
+                 tenant: str = "default") -> list[int]:
         from distributed_training_tpu.serving.engine import Request
 
         prompt = np.array(prompt, np.int32)
         pe = self.prefill_engine
         req = Request(id=req_id, prompt=prompt,
-                      max_new_tokens=max_new_tokens)
+                      max_new_tokens=max_new_tokens, tenant=tenant)
         pe.submit(req)
         # Drive ONLY prefill steps on the prefill slice: the request
         # completes its prompt and samples the first token there.
@@ -515,9 +516,12 @@ class DisaggPipeline:
         pe.cache.free(req.id)
         pe.slots[seq.slot] = None
         de = self.decode_engine
+        # The adopted Request keeps the ORIGINAL arrival and tenant:
+        # the decode-side trace must account the whole journey
+        # (prefill slice included) to the submitting tenant.
         de.adopt(Request(id=req_id, prompt=prompt,
                          max_new_tokens=max_new_tokens,
-                         arrival=req.arrival),
+                         arrival=req.arrival, tenant=tenant),
                  first_token, k, v)
         de.run_until_drained()
         rec = next(r for r in reversed(de.completed)

@@ -182,13 +182,17 @@ class Request:
     pages are RETAINED under this key instead of freed, and a later
     request with the same key whose prompt extends the retained
     history re-attaches them (zero prefill for the shared part;
-    an exact-history prompt needs zero prefill launches at all)."""
+    an exact-history prompt needs zero prefill launches at all).
+    ``tenant``: multi-tenant accounting label — threaded from the HTTP
+    JSON body into the per-request ``serving_trace`` record and the
+    tenant-labeled latency histograms; never affects scheduling."""
 
     id: str
     prompt: np.ndarray
     max_new_tokens: int
     arrival: float | None = None
     session: str | None = None
+    tenant: str = "default"
 
 
 @dataclass
@@ -201,6 +205,20 @@ class _Seq:
     token_times: list = field(default_factory=list)
     eos: bool = False             # emitted the configured stop token
     ngram: "NgramIndex | None" = None  # lazy prompt-lookup index
+    trace: list = field(default_factory=list)  # lifecycle spans
+    queue_wait_s: float | None = None  # arrival -> admission
+    prefix_hit: int = 0           # prompt tokens served from cache
+
+    def span(self, ev: str, t: float, **fields) -> None:
+        """Append a lifecycle span. ``t`` is an absolute monotonic
+        host timestamp taken at a point the host already occupies
+        (admission bookkeeping, the post-``_fetch_host`` reads every
+        launch path takes) — stored RELATIVE to arrival so the trace
+        is meaningful offline. Pure host-side list append: no device
+        touch, no sync, no recompile."""
+        rel = t - self.req.arrival if self.req.arrival is not None \
+            else t
+        self.trace.append({"ev": ev, "t": round(rel, 6), **fields})
 
     @property
     def prompt_len(self) -> int:
@@ -857,6 +875,54 @@ class Engine:
         self._validate(req)
         self.queue.append(req)
 
+    # -- request-lifecycle tracing ------------------------------------------
+    #
+    # Spans are host-side list appends at points the admission /
+    # launch bookkeeping already occupies; timestamps reuse the
+    # monotonic reads the engine already takes after ``_fetch_host``
+    # where one exists. Zero device syncs (DTT010), zero new jit
+    # entries, and the only write path is telemetry.event() — see
+    # telemetry/serving_trace.py for the schema the analyzer pins.
+
+    def _mark_admitted(self, seq: _Seq, ev: str, **fields) -> None:
+        """Open a sequence's trace: queued at t=0 (arrival), then the
+        admission span (``admitted`` / ``resumed`` / ``adopted``).
+        ``queue_wait_s`` is fixed here — a resubmitted-after-preempt
+        request keeps its ORIGINAL arrival, so its second trace shows
+        the full wait including the lost first pass."""
+        now = time.monotonic()
+        seq.trace.append({"ev": "queued", "t": 0.0})
+        seq.span(ev, now, slot=seq.slot, **fields)
+        if seq.req.arrival is not None:
+            seq.queue_wait_s = now - seq.req.arrival
+        seq.prefix_hit = int(fields.get("prefix_hit_tokens")
+                             or fields.get("hit_tokens") or 0)
+
+    def _emit_trace(self, seq: _Seq, outcome: str, now: float,
+                    tokens_discarded: int = 0) -> None:
+        """Close a sequence's trace and emit the ``serving_trace``
+        record through the ambient sink. ``now`` is a timestamp the
+        caller already took (post-fetch or preempt bookkeeping)."""
+        seq.span(outcome, now,
+                 **({"tokens_discarded": tokens_discarded}
+                    if outcome == "preempted" else {}))
+        arrival = seq.req.arrival
+        ttft = None
+        if seq.first_token_t is not None and arrival is not None:
+            ttft = seq.first_token_t - arrival
+        event("serving_trace",
+              id=seq.req.id,
+              tenant=seq.req.tenant,
+              outcome=outcome,
+              prompt_tokens=seq.prompt_len,
+              new_tokens=len(seq.generated),
+              queue_wait_s=seq.queue_wait_s,
+              ttft_s=ttft,
+              e2e_s=(now - arrival) if arrival is not None else None,
+              prefix_hit_tokens=seq.prefix_hit,
+              tokens_discarded=tokens_discarded,
+              spans=list(seq.trace))
+
     def add_token_listener(self, req_id: str, fn) -> None:
         """Register ``fn(token: int, done: bool)`` to fire as each of
         ``req_id``'s tokens is sampled (the HTTP streaming path).
@@ -951,6 +1017,8 @@ class Engine:
             self.cache.join(req.id, group=group)
             self.cache.ensure(req.id, first)
             seq = _Seq(req=req, slot=slot)
+            self._mark_admitted(seq, "admitted", group=group,
+                                prefix_hit_tokens=0)
             self.slots[slot] = seq
             return seq
         if req.session is not None and req.session in self.sessions:
@@ -1026,6 +1094,8 @@ class Engine:
             self.prefix_stats["saved_tokens"] += hit
             self._step_prefix[0] += hit
             self._step_prefix[1] += hit
+        self._mark_admitted(seq, "admitted", group=group,
+                            prefix_hit_tokens=hit)
         self.slots[slot] = seq
         return seq
 
@@ -1062,6 +1132,8 @@ class Engine:
                    prefilled=plen if exact else hl - 1)
         self.slots[slot] = seq
         saved = plen if exact else hl - 1
+        self._mark_admitted(seq, "resumed", group=sess["group"],
+                            session=key, hit_tokens=saved)
         self.prefix_stats["session_resumes"] += 1
         self.prefix_stats["hit_tokens"] += saved
         self.prefix_stats["saved_tokens"] += saved
@@ -1316,6 +1388,7 @@ class Engine:
             (lg,) = self._fetch_host(logits[g])
             tok = self._sample_host(lg)
             now = time.monotonic()
+            seq.span("prefill", now, tokens=n_valid)
             seq.first_token_t = now
             seq.token_times.append(now)
             seq.generated.append(tok)
@@ -1325,6 +1398,11 @@ class Engine:
             self._register(seq)
             self._maybe_finish(seq)
             return True
+        # Mid-prompt chunk: no fetch happens, so the span timestamp
+        # is the post-dispatch host clock (launch enqueue time under
+        # async dispatch — the token counts are the load-bearing
+        # fields; the sync-accurate timestamps are the fetched ones).
+        seq.span("prefill", time.monotonic(), tokens=n_valid)
         self._register(seq)
         return True
 
@@ -1422,12 +1500,15 @@ class Engine:
         total = 0
         fetched = None
         now = None
-        for g, seqs in enumerate(chosen):
+        t_launch = time.monotonic()  # dispatch-time stamp for lanes
+        for g, seqs in enumerate(chosen):  # that trigger no fetch
             for i, s in enumerate(seqs):
                 n = int(n_valid[g, i])
                 self.cache.advance(s.req.id, n)
                 s.prefilled += n
                 total += n
+                if not s.prefill_done:
+                    s.span("prefill", t_launch, tokens=n)
                 if s.prefill_done:
                     if fetched is None:
                         # ONE (G, Sp) int32 pull for the whole
@@ -1440,6 +1521,7 @@ class Engine:
                         (fetched,) = self._fetch_host(nxt)
                         now = time.monotonic()
                     tok = int(fetched[g, i])
+                    s.span("prefill", now, tokens=n)
                     s.first_token_t = now
                     s.token_times.append(now)
                     s.generated.append(tok)
@@ -1555,6 +1637,7 @@ class Engine:
             self.cache.advance(s.req.id, len(emit))
             self.spec_stats["launches"] += 1
             self.spec_stats["emitted"] += len(emit)
+            s.span("decode", now, emitted=len(emit), budget=n)
             for tok in emit:
                 s.generated.append(tok)
                 if self.cfg.eos_id >= 0 and \
@@ -1643,6 +1726,8 @@ class Engine:
             g, i = divmod(s.slot, B)
             e = int(n_emitted[g, i])
             self.cache.advance(s.req.id, e)
+            s.span("decode", now, emitted=e,
+                   budget=int(budget[g, i]))
             for t in range(e):
                 tok = int(out[g, i, t])
                 s.generated.append(tok)
@@ -1714,6 +1799,7 @@ class Engine:
         for s in stepped:
             g, i = divmod(s.slot, B)
             self.cache.advance(s.req.id, 1)
+            s.span("decode", now, emitted=1)
             tok = int(nxt[g, i])
             s.generated.append(tok)
             if self.cfg.eos_id >= 0 and tok == self.cfg.eos_id:
@@ -1741,13 +1827,15 @@ class Engine:
                 self._drop_session(key)
             cid = f"~session:{key}"
             self.cache.rename(seq.req.id, cid)
+            retain_t = time.monotonic()
             self.sessions[key] = {
                 "cache_id": cid,
                 "history": np.concatenate([
                     np.array(seq.req.prompt, np.int32),
                     np.array(seq.generated, np.int32)]),
                 "group": self.cache.group_of(cid),
-                "t": time.monotonic()}
+                "t": retain_t}
+            seq.span("session_retain", retain_t, session=key)
         else:
             self.cache.free(seq.req.id)
         self.slots[seq.slot] = None
@@ -1758,20 +1846,24 @@ class Engine:
                                       seq.token_times[1:])]
         rec = {
             "id": seq.req.id,
+            "tenant": seq.req.tenant,
             "prompt_tokens": seq.prompt_len,
             "new_tokens": len(seq.generated),
             "tokens": list(seq.generated),
             "ttft_s": (seq.first_token_t - arrival
                        if seq.first_token_t is not None else None),
+            "queue_wait_s": seq.queue_wait_s,
             "latency_s": now - arrival,
             "token_gaps_s": gaps,
             "group": self.group_of_slot(seq.slot),
         }
         self.completed.append(rec)
         event("serving_request",
-              **{k: rec[k] for k in ("id", "prompt_tokens",
-                                     "new_tokens", "ttft_s",
+              **{k: rec[k] for k in ("id", "tenant",
+                                     "prompt_tokens", "new_tokens",
+                                     "ttft_s", "queue_wait_s",
                                      "latency_s", "group")})
+        self._emit_trace(seq, "finished", now)
 
     # -- convenience -------------------------------------------------------
 
@@ -1840,6 +1932,7 @@ class Engine:
                 self.cache.join(req.id, group=group)
                 seq = _Seq(req=req, slot=slot,
                            prefilled=req.prompt.shape[0])
+                self._mark_admitted(seq, "adopted", group=group)
                 self.slots[slot] = seq
                 staged.append((seq, first_token, k_dense, v_dense))
             import_kv_batch(self.cache,
@@ -1883,14 +1976,22 @@ class Engine:
         prefill. Page content is untouched by the frees (a page is
         never reused while held), so the retained KV stays valid."""
         lost: list[Request] = []
+        now = time.monotonic()
         for i, s in enumerate(self.slots):
             if s is None:
                 continue
+            # Close the trace honestly BEFORE dropping the state:
+            # the tokens this incarnation computed and is about to
+            # throw away are recorded, so the offline retry-cost
+            # number is derived from the stream, never inferred.
+            self._emit_trace(s, "preempted", now,
+                             tokens_discarded=len(s.generated))
             self.cache.free(s.req.id)
             self.slots[i] = None
             lost.append(Request(id=s.req.id, prompt=s.req.prompt,
                                 max_new_tokens=s.req.max_new_tokens,
-                                arrival=s.req.arrival))
+                                arrival=s.req.arrival,
+                                tenant=s.req.tenant))
         lost.extend(self.queue)
         self.queue.clear()
         for req in lost:

@@ -333,6 +333,12 @@ class PagedKVCache:
     def length(self, seq_id) -> int:
         return self._lengths[seq_id]
 
+    def pages_of(self, seq_id) -> int:
+        """Pages in ``seq_id``'s table (shared pages count — they are
+        held, refcounted). The /debug/requests introspection read;
+        raises KeyError for unknown ids like every per-seq accessor."""
+        return len(self._tables[seq_id])
+
     # -- sharing: refcounted attach / COW / prefix index -------------------
 
     def attach(self, seq_id, pages, n_tokens: int) -> None:

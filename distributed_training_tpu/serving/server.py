@@ -71,12 +71,17 @@ class ServingServer:
         self._engine_thread = None
         self._http_thread = None
         self._next_id = 0
-        self.metrics = None
-        if metrics_port is not None:
-            from distributed_training_tpu.telemetry import (
-                MetricsServer)
-            self.metrics = MetricsServer(metrics_port,
-                                         telemetry=telemetry)
+        self._telemetry = telemetry
+        # A MetricsServer ALWAYS backs GET /metrics on the serving
+        # port (its renderer + observer, no second socket) so a
+        # serving-only deployment needs no coordinator metrics port;
+        # with ``metrics_port`` set the same instance additionally
+        # binds the standalone endpoint the trainer convention uses.
+        from distributed_training_tpu.telemetry import MetricsServer
+        self._metrics_owns_port = metrics_port is not None
+        self.metrics = MetricsServer(
+            metrics_port if metrics_port is not None else 0,
+            telemetry=telemetry)
 
     # -- engine thread -----------------------------------------------------
 
@@ -87,7 +92,8 @@ class ServingServer:
         while not self._stop.is_set():
             with self._lock:
                 incoming, self._mailbox = self._mailbox, []
-            for rid, prompt, n, arrival, session in incoming:
+            for rid, prompt, n, arrival, session, tenant \
+                    in incoming:
                 with self._lock:
                     stream_q = self._streams.get(rid)
                 if stream_q is not None:
@@ -101,7 +107,8 @@ class ServingServer:
                     eng.submit(Request(id=rid, prompt=prompt,
                                        max_new_tokens=n,
                                        arrival=arrival,
-                                       session=session))
+                                       session=session,
+                                       tenant=tenant))
                 except ValueError as e:
                     # An invalid request answers ITS caller; it must
                     # never take down the engine thread (and with it
@@ -135,12 +142,14 @@ class ServingServer:
 
     def generate(self, prompt: np.ndarray, max_new_tokens: int,
                  timeout: float = 120.0,
-                 session: str | None = None) -> dict:
+                 session: str | None = None,
+                 tenant: str = "default") -> dict:
         """Enqueue + wait (the HTTP handler path; also the in-process
         API tests use). ``session``: chat-session key — the engine
         retains the turn's KV pages under it and a follow-up call
         with the same key resumes with zero prefill for the retained
-        history (serving/engine.py)."""
+        history (serving/engine.py). ``tenant``: accounting label for
+        the per-tenant latency histograms and trace records."""
         arrival = time.monotonic()
         ev = threading.Event()
         with self._lock:
@@ -149,7 +158,7 @@ class ServingServer:
             self._events[rid] = ev
             self._mailbox.append((rid, np.array(prompt, np.int32),
                                   int(max_new_tokens), arrival,
-                                  session))
+                                  session, tenant))
         if not ev.wait(timeout):
             with self._lock:
                 # Deregister so a late completion is dropped instead
@@ -163,7 +172,8 @@ class ServingServer:
     def generate_stream(self, prompt: np.ndarray,
                         max_new_tokens: int,
                         timeout: float = 120.0,
-                        session: str | None = None):
+                        session: str | None = None,
+                        tenant: str = "default"):
         """Enqueue + yield per-token dicts as the engine produces
         them: ``{"token": N}`` per sampled token, then a final
         ``{"done": True, "tokens", "ttft_s", "latency_s"}``. The
@@ -177,7 +187,7 @@ class ServingServer:
             self._streams[rid] = q
             self._mailbox.append((rid, np.array(prompt, np.int32),
                                   int(max_new_tokens), arrival,
-                                  session))
+                                  session, tenant))
         deadline = time.monotonic() + timeout
         try:
             while True:
@@ -217,10 +227,11 @@ class ServingServer:
     # -- HTTP --------------------------------------------------------------
 
     def _parse_generate(self, body: dict):
-        """Validate a /generate body → (prompt_ids, max_new_tokens).
-        Raises ValueError (the 400 path) BEFORE anything reaches the
-        engine — the streaming handler needs every rejection to
-        happen while the status line is still writable."""
+        """Validate a /generate body → (prompt_ids, max_new_tokens,
+        session, tenant). Raises ValueError (the 400 path) BEFORE
+        anything reaches the engine — the streaming handler needs
+        every rejection to happen while the status line is still
+        writable."""
         vocab = self.engine.model.cfg.vocab_size
         if "prompt_ids" in body:
             ids = np.array([int(t) for t in body["prompt_ids"]],
@@ -248,11 +259,14 @@ class ServingServer:
         session = body.get("session")
         if session is not None and not isinstance(session, str):
             raise ValueError("'session' must be a string key")
-        return ids, n, session
+        tenant = body.get("tenant", "default")
+        if not isinstance(tenant, str) or not tenant:
+            raise ValueError("'tenant' must be a non-empty string")
+        return ids, n, session, tenant
 
     def _handle_generate(self, body: dict) -> dict:
-        ids, n, session = self._parse_generate(body)
-        rec = self.generate(ids, n, session=session)
+        ids, n, session, tenant = self._parse_generate(body)
+        rec = self.generate(ids, n, session=session, tenant=tenant)
         if "error" in rec:
             raise ValueError(rec["error"])
         out = {"tokens": rec["tokens"], "ttft_s": rec["ttft_s"],
@@ -264,6 +278,9 @@ class ServingServer:
         return out
 
     def start(self) -> "ServingServer | None":
+        from distributed_training_tpu.telemetry.metrics_server \
+            import PROM_CONTENT_TYPE
+
         server = self
 
         class Handler(http.server.BaseHTTPRequestHandler):
@@ -292,12 +309,14 @@ class ServingServer:
 
             def _stream_generate(self, body: dict) -> None:
                 try:
-                    ids, n, session = server._parse_generate(body)
+                    ids, n, session, tenant = \
+                        server._parse_generate(body)
                 except (ValueError, KeyError) as e:
                     self._reply(400, {"error": str(e)})
                     return
                 gen = server.generate_stream(ids, n,
-                                             session=session)
+                                             session=session,
+                                             tenant=tenant)
                 self.send_response(200)
                 self.send_header("Content-Type",
                                  "application/jsonl")
@@ -353,16 +372,58 @@ class ServingServer:
                     self._reply(504, {"error": str(e)})
 
             def do_GET(self):  # noqa: N802 — http.server API
-                if self.path.split("?")[0] != "/healthz":
-                    self._reply(404, {"error": "try /healthz or the "
-                                               "metrics port"})
-                    return
+                path = self.path.split("?")[0]
                 eng = server.engine
-                self._reply(200, {
-                    "status": "ok",
-                    "in_flight": eng.in_flight,
-                    "queue_depth": len(eng.queue),
-                    **eng.cache.occupancy()})
+                if path == "/healthz":
+                    self._reply(200, {
+                        "status": "ok",
+                        "in_flight": eng.in_flight,
+                        "queue_depth": len(eng.queue),
+                        **eng.cache.occupancy()})
+                    return
+                if path == "/metrics":
+                    body = server.metrics.render().encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     PROM_CONTENT_TYPE)
+                    self.send_header("Content-Length",
+                                     str(len(body)))
+                    self.send_header("Connection", "close")
+                    self.close_connection = True
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                if path == "/debug/requests":
+                    # Engine bookkeeping only — slot table + page
+                    # tables, zero device touch. Best-effort
+                    # snapshot: the engine thread mutates slots
+                    # between reads, so a sequence finishing mid-
+                    # render is simply absent.
+                    reqs = []
+                    for s in list(eng.slots):
+                        if s is None:
+                            continue
+                        try:
+                            reqs.append({
+                                "id": s.req.id,
+                                "tenant": s.req.tenant,
+                                "group": eng.group_of_slot(s.slot),
+                                "slot": s.slot,
+                                "prompt_tokens": s.prompt_len,
+                                "prefilled": s.prefilled,
+                                "generated": len(s.generated),
+                                "pages_held":
+                                    eng.cache.pages_of(s.req.id),
+                                "session": s.req.session})
+                        except KeyError:
+                            continue  # freed between reads
+                    self._reply(200, {
+                        "in_flight": len(reqs),
+                        "queue_depth": len(eng.queue),
+                        "requests": reqs})
+                    return
+                self._reply(404, {"error": "try /healthz, /metrics "
+                                           "or /debug/requests"})
 
             def log_message(self, fmt, *args):
                 logger.debug("serving http: " + fmt, *args)
@@ -375,8 +436,20 @@ class ServingServer:
                            "%s", self._requested_port, e)
             return None
         self.port = self._httpd.server_address[1]
-        if self.metrics is not None:
+        if self._metrics_owns_port:
             self.metrics.start()
+        else:
+            # Renderer-only mode: no second socket, but the observer
+            # must still fold records so GET /metrics on THIS port
+            # has data (MetricsServer.start() normally registers it
+            # post-bind). The engine emits through the AMBIENT sink
+            # when none was passed explicitly, so observe that one;
+            # the disabled default sink never calls observers, which
+            # degrades to an empty (but valid) exposition.
+            from distributed_training_tpu.telemetry import current
+            tel = self._telemetry if self._telemetry is not None \
+                else current()
+            tel.add_observer(self.metrics.observe)
         self._engine_thread = threading.Thread(
             target=self._engine_loop, name="serving-engine",
             daemon=True)
@@ -494,8 +567,11 @@ def main(argv=None) -> int:
     srv_conf = conf.get("server") or {}
     port = args.port if args.port is not None \
         else int(srv_conf.get("port", 8100))
+    mp_conf = srv_conf.get("metrics_port", 8101)
+    # metrics_port: null in the config = no standalone endpoint; the
+    # serving port's own GET /metrics still works (renderer-only).
     metrics_port = args.metrics_port if args.metrics_port is not None \
-        else int(srv_conf.get("metrics_port", 8101))
+        else (int(mp_conf) if mp_conf is not None else None)
 
     import os
 

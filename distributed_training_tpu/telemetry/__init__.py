@@ -21,7 +21,11 @@ One instrumentation surface, four consumers:
   lives in xplane.py (stdlib XSpace reader, shared with
   benchmarks/analyze_trace.py);
 - ``MetricsServer`` (metrics_server.py) — the coordinator's live
-  Prometheus endpoint + /healthz, fed from this sink;
+  Prometheus endpoint + /healthz, fed from this sink (plus the
+  tenant-labeled serving latency histograms);
+- ``analyze_traces`` (serving_trace.py) — per-tenant SLO ledger
+  reconstructed offline from the serving engine's ``serving_trace``
+  request-lifecycle records (``--serving-report``);
 - the multi-host aggregator (aggregate.py) — merges per-host
   ``host_<i>/events.jsonl`` streams into one clock-aligned report.
 
@@ -53,6 +57,11 @@ from distributed_training_tpu.telemetry.hbm import (  # noqa: F401
 )
 from distributed_training_tpu.telemetry.metrics_server import (  # noqa: F401
     MetricsServer,
+)
+from distributed_training_tpu.telemetry.serving_trace import (  # noqa: F401
+    analyze_traces,
+    render_serving_lines,
+    slo_attainment,
 )
 from distributed_training_tpu.telemetry.straggler import (  # noqa: F401
     StragglerDetector,

@@ -44,6 +44,37 @@ logger = logging.getLogger(__name__)
 
 PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
+# Fixed histogram bucket bounds (seconds / tokens). FIXED and
+# documented on purpose: Prometheus histograms aggregate across
+# hosts/scrapes only when every emitter uses identical ``le`` bounds —
+# a per-host adaptive choice would make fleet-level quantiles
+# meaningless. Bounds follow the Prometheus latency idiom
+# (1-2.5-5 per decade); tokens/request uses powers of two up to the
+# engine's typical max_seq_len scale. The gauge
+# ``dtt_serving_ttft_seconds`` (last finished request) stays for
+# dashboards; these histograms are the SLO source of truth.
+#
+# Naming note: the TTFT histogram is ``time_to_first_token`` in full
+# because the short name already belongs to the LAST-VALUE gauge
+# ``dtt_serving_ttft_seconds`` (pinned schema since r01) and the
+# exposition format forbids two metric families under one name — a
+# same-name gauge + histogram pair is a scrape error, not a style
+# choice.
+HIST_BUCKETS: dict[str, tuple[float, ...]] = {
+    "serving_time_to_first_token_seconds": (
+        0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+        1.0, 2.5, 5.0, 10.0),
+    "serving_e2e_seconds": (
+        0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+        30.0, 60.0),
+    "serving_queue_wait_seconds": (
+        0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+        1.0, 2.5, 5.0, 10.0),
+    "serving_tokens_per_request": (
+        1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0, 256.0, 512.0,
+        1024.0),
+}
+
 
 class MetricsServer:
     """Prometheus endpoint fed by Telemetry records.
@@ -71,6 +102,10 @@ class MetricsServer:
         # (the per-dp-group serving gauges; rendered as
         # dtt_<name>{group="N"} rows, additive next to the flat set).
         self._labeled: dict[str, dict[str, float]] = {}
+        # Histogram families: name -> {tenant -> state}. Bounds are
+        # the module-level HIST_BUCKETS; state is cumulative-ready
+        # (per-bound counts + sum + count, +Inf implied by count).
+        self._hists: dict[str, dict[str, dict]] = {}
         self._counters: dict[str, float] = {"steps_total": 0.0,
                                             "straggler_verdicts_total":
                                                 0.0,
@@ -260,6 +295,39 @@ class MetricsServer:
                 self._counters["serving_requests_total"] = \
                     self._counters.get("serving_requests_total",
                                        0.0) + 1
+                # Per-tenant latency histograms — the SLO source of
+                # truth (the gauge above is last-value only). One
+                # observation per finished request, labeled by the
+                # tenant the HTTP body carried (engine default:
+                # "default").
+                tenant = rec.get("tenant")
+                if not isinstance(tenant, str) or not tenant:
+                    tenant = "default"
+                for src, name in (
+                        ("ttft_s",
+                         "serving_time_to_first_token_seconds"),
+                        ("latency_s", "serving_e2e_seconds"),
+                        ("queue_wait_s",
+                         "serving_queue_wait_seconds"),
+                        ("new_tokens",
+                         "serving_tokens_per_request")):
+                    v = rec.get(src)
+                    if isinstance(v, (int, float)):
+                        self._hist_observe(name, tenant, float(v))
+
+    def _hist_observe(self, name: str, tenant: str,
+                      value: float) -> None:
+        """Fold one observation into a tenant-labeled histogram.
+        Caller holds ``self._lock``."""
+        bounds = HIST_BUCKETS[name]
+        fam = self._hists.setdefault(name, {})
+        st = fam.setdefault(tenant, {
+            "counts": [0] * len(bounds), "sum": 0.0, "count": 0})
+        for i, b in enumerate(bounds):
+            if value <= b:
+                st["counts"][i] += 1
+        st["sum"] += value
+        st["count"] += 1
 
     # -- health --------------------------------------------------------
 
@@ -327,8 +395,23 @@ class MetricsServer:
         "serving_kv_pages_used": "KV-cache pages allocated",
         "serving_kv_pages_total": "KV-cache pages in the pool "
                                   "(scratch excluded)",
-        "serving_ttft_seconds": "Time-to-first-token of the last "
-                                "completed request",
+        "serving_ttft_seconds": "Time-to-first-token of the LAST "
+                                "FINISHED request only (a gauge — "
+                                "quantiles and SLOs come from the "
+                                "dtt_serving_time_to_first_token_"
+                                "seconds histogram)",
+        "serving_time_to_first_token_seconds":
+            "Time-to-first-token per finished request, by tenant "
+            "(histogram; the SLO source of truth)",
+        "serving_e2e_seconds": "Arrival-to-finish latency per "
+                               "finished request, by tenant "
+                               "(histogram)",
+        "serving_queue_wait_seconds": "Arrival-to-admission wait per "
+                                      "finished request, by tenant "
+                                      "(histogram)",
+        "serving_tokens_per_request": "New tokens generated per "
+                                      "finished request, by tenant "
+                                      "(histogram)",
         "serving_tokens_per_s": "Decode throughput of the last "
                                 "engine step",
         "serving_prefill_tokens_per_s": "Aggregate prompt tokens/s "
@@ -378,6 +461,11 @@ class MetricsServer:
             gauges = dict(self._gauges)
             counters = dict(self._counters)
             labeled = {k: dict(v) for k, v in self._labeled.items()}
+            hists = {name: {t: {"counts": list(st["counts"]),
+                                "sum": st["sum"],
+                                "count": st["count"]}
+                            for t, st in fam.items()}
+                     for name, fam in self._hists.items()}
         gauges["up"] = 1.0
         lines: list[str] = []
         for name, value in sorted(gauges.items()):
@@ -396,6 +484,21 @@ class MetricsServer:
             lines.append(f"# HELP {full} {self._HELP.get(name, name)}")
             lines.append(f"# TYPE {full} counter")
             lines.append(f"{full} {_fmt(value)}")
+        for name, fam in sorted(hists.items()):
+            full = f"dtt_{name}"
+            bounds = HIST_BUCKETS[name]
+            lines.append(f"# HELP {full} {self._HELP.get(name, name)}")
+            lines.append(f"# TYPE {full} histogram")
+            for tenant, st in sorted(fam.items()):
+                lbl = f'tenant="{tenant}"'
+                for b, c in zip(bounds, st["counts"]):
+                    lines.append(
+                        f'{full}_bucket{{{lbl},le="{_fmt(b)}"}} {c}')
+                lines.append(
+                    f'{full}_bucket{{{lbl},le="+Inf"}} {st["count"]}')
+                lines.append(f'{full}_sum{{{lbl}}} '
+                             f'{_fmt(st["sum"])}')
+                lines.append(f'{full}_count{{{lbl}}} {st["count"]}')
         return "\n".join(lines) + "\n"
 
     # -- HTTP ----------------------------------------------------------

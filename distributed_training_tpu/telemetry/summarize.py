@@ -303,6 +303,21 @@ def _recovery(events: list[dict]) -> dict | None:
     }
 
 
+def _serving(events: list[dict],
+             slo: tuple[float, float] | None = None) -> dict | None:
+    """Per-tenant serving SLO ledger reconstructed from the
+    ``serving_trace`` stream (telemetry/serving_trace.py — the same
+    analyzer bench_serving.py ledgers with, so the report and
+    SERVING_rNN.json cannot disagree). None when the run served
+    nothing."""
+    from distributed_training_tpu.telemetry.serving_trace import (
+        analyze_traces, slo_deadlines_from_conf)
+    ttft_s, per_token_s = slo if slo is not None \
+        else slo_deadlines_from_conf()
+    return analyze_traces(events, ttft_deadline_s=ttft_s,
+                          per_token_deadline_s=per_token_s)
+
+
 def _spans(events: list[dict]) -> dict:
     agg: dict[str, dict] = {}
     for e in events:
@@ -337,6 +352,7 @@ def summarize_run(run_dir: str) -> dict:
         "attribution": _attribution(events),
         "attribution_static": _attribution_static(events),
         "recovery": _recovery(events),
+        "serving": _serving(events),
         "spans": _spans(events),
         "watchdog_firings": [e for e in events
                              if e.get("kind") == "watchdog_fired"],
@@ -473,6 +489,11 @@ def render(summary: dict) -> str:
     rec = summary.get("recovery")
     if rec:
         lines.extend(render_recovery_lines(rec))
+    srv = summary.get("serving")
+    if srv:
+        from distributed_training_tpu.telemetry.serving_trace import (
+            render_serving_lines)
+        lines.extend(render_serving_lines(srv))
     for w in summary.get("watchdog_firings", []):
         lines.append(f"WATCHDOG FIRED: {w.get('postmortem')}")
     for p in summary.get("postmortems", []):
@@ -494,10 +515,49 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--write-merged", default=None, metavar="PATH",
                    help="multi-host only: also write the merged, "
                         "clock-aligned event timeline as jsonl")
+    p.add_argument("--serving-report", action="store_true",
+                   help="print ONLY the serving SLO ledger "
+                        "reconstructed from serving_trace records "
+                        "(per-tenant p50/p95/p99 TTFT/e2e, SLO "
+                        "attainment, preemption retry cost)")
+    p.add_argument("--slo-ttft-s", type=float, default=None,
+                   help="TTFT deadline for --serving-report "
+                        "(default: conf/serving/default.yaml slo:)")
+    p.add_argument("--slo-per-token-s", type=float, default=None,
+                   help="per-token decode deadline for "
+                        "--serving-report (default: conf/serving/"
+                        "default.yaml slo:)")
     args = p.parse_args(argv)
     if not os.path.isdir(args.run_dir):
         print(f"not a directory: {args.run_dir}", file=sys.stderr)
         return 2
+    if args.serving_report:
+        from distributed_training_tpu.telemetry.serving_trace import (
+            render_serving_lines, slo_deadlines_from_conf)
+        ttft_s, per_token_s = slo_deadlines_from_conf()
+        if args.slo_ttft_s is not None:
+            ttft_s = args.slo_ttft_s
+        if args.slo_per_token_s is not None:
+            per_token_s = args.slo_per_token_s
+        # serving_trace records are self-contained (span times are
+        # arrival-relative), so multi-host dirs just concatenate —
+        # no clock alignment needed.
+        events = load_jsonl(os.path.join(args.run_dir,
+                                         "events.jsonl"))
+        for name in sorted(os.listdir(args.run_dir)):
+            sub = os.path.join(args.run_dir, name, "events.jsonl")
+            if name.startswith("host_") and os.path.exists(sub):
+                events.extend(load_jsonl(sub))
+        rep = _serving(events, slo=(ttft_s, per_token_s))
+        if rep is None:
+            print("no serving_trace records in "
+                  f"{args.run_dir}", file=sys.stderr)
+            return 1
+        if args.json:
+            print(json.dumps(rep))
+        else:
+            print("\n".join(render_serving_lines(rep)))
+        return 0
     from distributed_training_tpu.telemetry import aggregate
     if aggregate.is_multihost_run_dir(args.run_dir):
         summary = aggregate.aggregate_run(args.run_dir)

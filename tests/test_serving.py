@@ -2145,3 +2145,273 @@ def test_serving_r05_ledger_committed_and_coherent():
     # The r04 lanes all still ride the r05 entry.
     assert doc["int8"]["argmax_parity"] is True
     assert doc["preemption"]["tokens_match_steady_storm"] is True
+
+
+# ---------------------------------------------------------------------------
+# SERVING_r06: request-lifecycle tracing + per-tenant observability
+# ---------------------------------------------------------------------------
+
+
+def _trace_collector(tmp_path):
+    """Installed Telemetry + a live list of serving_trace records."""
+    from distributed_training_tpu.telemetry import Telemetry, install
+
+    recs = []
+    tel = Telemetry(events_jsonl=str(tmp_path / "events.jsonl"))
+    tel.add_observer(lambda r: recs.append(r)
+                     if r.get("kind") == "serving_trace" else None)
+    install(tel)
+    return tel, recs
+
+
+def test_trace_lifecycle_preempt_resubmit_finish(tiny_model,
+                                                 tmp_path):
+    """The full span story of one request that gets evicted mid-
+    decode and retried: trace 1 closes ``outcome=preempted`` with its
+    discarded tokens BEFORE the state is freed; the resubmit (same
+    Request, ORIGINAL arrival) opens trace 2, whose admitted span's
+    relative time covers the lost first pass, ending ``finished``.
+    The record's payload keys are the pinned TRACE_KEYS schema."""
+    from distributed_training_tpu.telemetry import uninstall
+    from distributed_training_tpu.telemetry.serving_trace import (
+        SPAN_EVENTS, TRACE_KEYS)
+
+    model, params = tiny_model
+    tel, recs = _trace_collector(tmp_path)
+    try:
+        eng = _engine(model, params)
+        eng.submit(Request(id="tr-1",
+                           prompt=np.asarray([5, 6, 7, 8], np.int32),
+                           max_new_tokens=6,
+                           arrival=time.monotonic()))
+        for _ in range(3):  # prefill + a couple of decode steps
+            eng.step()
+        lost = eng.preempt()
+        assert [r.id for r in lost] == ["tr-1"]
+        assert len(recs) == 1
+        pre = recs[0]
+        assert pre["outcome"] == "preempted"
+        assert pre["tokens_discarded"] == pre["new_tokens"] >= 1
+        assert pre["spans"][-1]["ev"] == "preempted"
+        assert pre["spans"][-1]["tokens_discarded"] == \
+            pre["tokens_discarded"]
+
+        eng.submit(lost[0])  # original arrival rides along
+        eng.run_until_drained()
+        assert len(recs) == 2
+        fin = recs[1]
+        assert fin["outcome"] == "finished"
+        assert fin["id"] == "tr-1" and fin["tenant"] == "default"
+        assert fin["prompt_tokens"] == 4 and fin["new_tokens"] == 6
+        evs = [s["ev"] for s in fin["spans"]]
+        assert evs[0] == "queued" and evs[1] == "admitted"
+        assert evs[-1] == "finished"
+        assert "prefill" in evs and "decode" in evs
+        assert set(evs) <= set(SPAN_EVENTS)
+        # Span times are arrival-relative and monotone; the retry's
+        # admission happened AFTER the first pass was discarded.
+        ts = [s["t"] for s in fin["spans"][1:]]
+        assert ts == sorted(ts) and min(ts) >= 0.0
+        assert fin["spans"][1]["t"] >= pre["spans"][-1]["t"]
+        assert fin["ttft_s"] >= 0 and fin["e2e_s"] >= fin["ttft_s"]
+        assert fin["queue_wait_s"] >= 0
+        # Schema pin: envelope (kind, t) + exactly TRACE_KEYS.
+        for rec in recs:
+            assert set(rec) - {"kind", "t"} == set(TRACE_KEYS)
+    finally:
+        uninstall()
+        tel.close()
+
+
+def test_tracing_adds_no_recompiles_and_no_host_syncs(tiny_model,
+                                                      tmp_path):
+    """The DTT010 story as a measured equality: the identical backlog
+    drained with tracing ON (Telemetry installed) and OFF must report
+    the SAME host-sync count and the SAME compile counts — span
+    capture is host-side bookkeeping, never a device sync — and the
+    token streams stay byte-identical."""
+    from distributed_training_tpu.telemetry import uninstall
+
+    model, params = tiny_model
+    rng = np.random.default_rng(7)
+    backlog = [(f"b-{i}",
+                rng.integers(0, 256, size=int(rng.integers(3, 9)))
+                .astype(np.int32)) for i in range(5)]
+
+    def drain(traced):
+        eng = _engine(model, params)
+        warm = eng.warmup()
+        h0 = eng.host_syncs
+        for rid, prompt in backlog:
+            eng.submit(Request(id=rid, prompt=prompt,
+                               max_new_tokens=5,
+                               arrival=time.monotonic()))
+        eng.run_until_drained()
+        assert eng.compile_counts() == warm, \
+            f"recompiled (traced={traced})"
+        return (eng.host_syncs - h0,
+                {r["id"]: r["tokens"] for r in eng.completed})
+
+    syncs_off, toks_off = drain(traced=False)
+    tel, recs = _trace_collector(tmp_path)
+    try:
+        syncs_on, toks_on = drain(traced=True)
+    finally:
+        uninstall()
+        tel.close()
+    assert toks_on == toks_off
+    assert syncs_on == syncs_off, \
+        "tracing changed the host-sync count"
+    assert len(recs) == len(backlog)
+
+
+def test_debug_requests_endpoint(tiny_model):
+    """GET /debug/requests snapshots the in-flight engine state
+    (id, tenant, slot geometry, progress, pages held) without
+    touching the device — polled live while a request decodes."""
+    import threading
+    import urllib.request
+
+    from distributed_training_tpu.serving.server import ServingServer
+
+    model, params = tiny_model
+    srv = ServingServer(_engine(model, params), port=0)
+    assert srv.start() is not None
+    try:
+        def post():
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{srv.port}/generate",
+                data=json.dumps({"prompt_ids": [1, 2, 3, 4],
+                                 "max_new_tokens": 48,
+                                 "tenant": "acme"}).encode(),
+                headers={"Content-Type": "application/json"})
+            return json.loads(
+                urllib.request.urlopen(req, timeout=120).read())
+
+        th = threading.Thread(target=post)
+        th.start()
+        seen = None
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            body = json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/debug/requests",
+                timeout=10).read())
+            assert set(body) == {"in_flight", "queue_depth",
+                                 "requests"}
+            if body["requests"]:
+                seen = body
+                break
+        th.join(timeout=120)
+        assert seen is not None, \
+            "never observed the request in /debug/requests"
+        [row] = seen["requests"]
+        assert row["id"] == "http-0" or row["id"].startswith("http-")
+        assert row["tenant"] == "acme"
+        assert row["session"] is None
+        assert row["prompt_tokens"] == 4
+        assert 0 <= row["generated"] <= 48
+        assert row["pages_held"] >= 1
+        assert isinstance(row["group"], int)
+        assert isinstance(row["slot"], int)
+        assert seen["in_flight"] == 1
+    finally:
+        srv.stop()
+
+
+def test_metrics_on_serving_port_with_tenant_histograms(tiny_model,
+                                                        tmp_path):
+    """Satellite (b) + the tenant-label thread: with NO standalone
+    metrics port, the serving port itself answers GET /metrics via
+    the shared renderer, and a request's JSON-body tenant shows up
+    as the {tenant=...} label on every latency histogram family.
+    The pinned last-value ttft gauge stays next to them."""
+    import urllib.request
+
+    from distributed_training_tpu.telemetry import uninstall
+
+    model, params = tiny_model
+    tel, _recs = _trace_collector(tmp_path)
+    try:
+        from distributed_training_tpu.serving.server import (
+            ServingServer)
+        srv = ServingServer(_engine(model, params), port=0)
+        assert srv.start() is not None
+        try:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{srv.port}/generate",
+                data=json.dumps({"prompt_ids": [9, 8, 7],
+                                 "max_new_tokens": 4,
+                                 "tenant": "acme"}).encode(),
+                headers={"Content-Type": "application/json"})
+            out = json.loads(
+                urllib.request.urlopen(req, timeout=120).read())
+            assert len(out["tokens"]) == 4
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/metrics",
+                timeout=10).read().decode()
+            for fam in ("dtt_serving_time_to_first_token_seconds",
+                        "dtt_serving_e2e_seconds",
+                        "dtt_serving_queue_wait_seconds",
+                        "dtt_serving_tokens_per_request"):
+                assert f'{fam}_bucket{{tenant="acme",le="+Inf"}} 1' \
+                    in body, f"{fam} missing its acme +Inf bucket"
+                assert f'{fam}_count{{tenant="acme"}} 1' in body
+                assert f'{fam}_sum{{tenant="acme"}}' in body
+                assert f"# TYPE {fam} histogram" in body
+            # tokens_per_request: 4 new tokens -> the le="4" bucket.
+            assert ('dtt_serving_tokens_per_request_bucket'
+                    '{tenant="acme",le="4"} 1') in body
+            # The last-value gauge survives next to the histograms.
+            assert "\ndtt_serving_ttft_seconds " in body
+            assert "dtt_serving_requests_total 1" in body
+        finally:
+            srv.stop()
+    finally:
+        uninstall()
+        tel.close()
+
+
+def test_serving_r06_ledger_committed_and_coherent():
+    """SERVING_r06.json: the observability acceptance gates stay
+    machine-checked — tracing-on re-run with zero recompiles and an
+    UNCHANGED host-sync count vs the untraced same-run drain, and a
+    per-tenant SLO block (p50/p95/p99 TTFT + attainment) for the
+    mixed chat/docs/bursty scenario scored against the committed
+    conf deadlines."""
+    import os
+
+    root = os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))
+    with open(os.path.join(root, "SERVING_r06.json")) as f:
+        doc = json.load(f)
+    with open(os.path.join(root, "SERVING_r05.json")) as f:
+        r05 = json.load(f)
+    assert doc["revision"] == "r06"
+    tr = doc["tracing"]
+    assert tr["recompiles_after_warmup"] == 0
+    assert tr["host_syncs_unchanged"] is True
+    assert tr["saturated_host_syncs_traced"] == \
+        tr["saturated_host_syncs_untraced"]
+    slo = doc["slo"]
+    assert slo["ttft_deadline_s"] == 0.25
+    assert slo["per_token_deadline_s"] == 0.05
+    rep = slo["report"]
+    assert set(rep["tenants"]) == {"chat", "docs", "bursty"}
+    for trep in rep["tenants"].values():
+        q = trep["ttft_s"]
+        assert q["p50"] is not None
+        assert q["p50"] <= q["p95"] <= q["p99"]
+        assert 0.0 <= trep["slo"]["attained"] <= 1.0
+    assert rep["overall"]["preemptions"] >= 1
+    assert 0.0 <= rep["overall"]["slo"]["attained"] <= 1.0
+    # The retry cost of the mid-storm preempt is accounted.
+    assert rep["overall"]["tokens_discarded"] >= 1
+    cmp_block = doc["compared_to"]
+    assert cmp_block["revision"] == "r05"
+    assert cmp_block["tokens_per_s"] == \
+        r05["saturated"]["tokens_per_s"]
+    # The r05 lanes all still ride the r06 entry.
+    assert doc["steady"]["recompiles_after_warmup"] == 0
+    assert doc["prefix"]["compared_to"]["reduction_x"] >= 4.0
+    assert doc["session"]["zero_prefill_resume"] is True
+    assert doc["preemption"]["tokens_match_steady_storm"] is True

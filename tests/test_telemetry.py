@@ -565,3 +565,181 @@ def test_trainer_no_telemetry_still_trains(cpu8, tmp_path):
     assert np.isfinite(summary["mean_loss"])
     assert "goodput" not in summary
     assert not os.path.exists(cfg.train.events_jsonl)
+
+
+# -- serving observability: histograms, trace schema, SLO report -----------
+
+
+def test_serving_histograms_bucket_math():
+    """The tenant-labeled latency histograms against hand-computed
+    cumulative bucket counts: each observation lands in EVERY bucket
+    whose bound admits it (Prometheus-cumulative), +Inf equals the
+    count, and the sum is exact. The hand-computed nearest-rank p50
+    must fall inside the first bucket whose cumulative count reaches
+    rank — the quantile a scraper would reconstruct brackets the
+    true one."""
+    from distributed_training_tpu.telemetry.metrics_server import (
+        HIST_BUCKETS, MetricsServer)
+
+    ms = MetricsServer(0)
+    ttfts = {"a": [0.004, 0.011, 0.011, 0.3], "b": [0.05]}
+    for tenant, vs in ttfts.items():
+        for v in vs:
+            ms.observe({"kind": "serving_request", "tenant": tenant,
+                        "id": "x", "ttft_s": v, "latency_s": 2 * v,
+                        "queue_wait_s": 0.0, "new_tokens": 3})
+    body = ms.render()
+    fam = "dtt_serving_time_to_first_token_seconds"
+    # Cumulative counts for tenant a over the pinned bounds.
+    bounds = HIST_BUCKETS["serving_time_to_first_token_seconds"]
+    want = {b: sum(1 for v in ttfts["a"] if v <= b) for b in bounds}
+    assert want[0.005] == 1 and want[0.01] == 1 \
+        and want[0.025] == 3 and want[0.25] == 3 and want[0.5] == 4
+    for b, c in want.items():
+        bs = str(int(b)) if b == int(b) else repr(float(b))
+        assert f'{fam}_bucket{{tenant="a",le="{bs}"}} {c}' in body
+    assert f'{fam}_bucket{{tenant="a",le="+Inf"}} 4' in body
+    assert f'{fam}_count{{tenant="a"}} 4' in body
+    sum_line = [ln for ln in body.splitlines()
+                if ln.startswith(f'{fam}_sum{{tenant="a"}}')][0]
+    assert float(sum_line.split()[-1]) == pytest.approx(0.326)
+    # le is inclusive: 0.05 lands in the 0.05 bucket.
+    assert f'{fam}_bucket{{tenant="b",le="0.05"}} 1' in body
+    # Nearest-rank p50 of [0.004, 0.011, 0.011, 0.3] is 0.011; the
+    # first bucket with cumulative count >= 2 is le=0.025 — the
+    # scrape-side quantile estimate brackets the exact one.
+    from distributed_training_tpu.telemetry.serving_trace import (
+        percentile)
+    exact = percentile(sorted(ttfts["a"]), 50)
+    est_bucket = min(b for b, c in want.items() if c >= 2)
+    assert exact == 0.011 and exact <= est_bucket == 0.025
+    # The four families all carry the tenant label.
+    for name in ("dtt_serving_e2e_seconds",
+                 "dtt_serving_queue_wait_seconds",
+                 "dtt_serving_tokens_per_request"):
+        assert f'{name}_count{{tenant="a"}} 4' in body
+        assert f"# TYPE {name} histogram" in body
+
+
+def test_serving_trace_schema_keys_pinned():
+    """The serving_trace record schema is pinned: additive keys only
+    (TRACE_KEYS is the contract the offline analyzer and the span
+    tests consume), and the aggregate stream schema stays 1."""
+    from distributed_training_tpu.telemetry import aggregate
+    from distributed_training_tpu.telemetry.serving_trace import (
+        OUTCOMES, SPAN_EVENTS, TRACE_KEYS)
+
+    assert TRACE_KEYS == (
+        "id", "tenant", "outcome", "prompt_tokens", "new_tokens",
+        "queue_wait_s", "ttft_s", "e2e_s", "prefix_hit_tokens",
+        "tokens_discarded", "spans")
+    assert set(SPAN_EVENTS) == {
+        "queued", "admitted", "resumed", "adopted", "prefill",
+        "decode", "session_retain", "finished", "preempted"}
+    assert OUTCOMES == ("finished", "preempted")
+    assert aggregate.SCHEMA == 1
+
+
+def _synthetic_serving_run(tmp_path):
+    """A run dir whose events.jsonl holds hand-written serving_trace
+    records with KNOWN latencies, so the report's nearest-rank
+    percentiles and attainment fractions are exact pins."""
+    run_dir = tmp_path / "srun"
+    run_dir.mkdir()
+    ttfts = {"chat": [0.01, 0.02, 0.03, 0.04, 0.05],
+             "docs": [0.1, 0.2, 0.3, 0.4, 0.5]}
+    with open(run_dir / "events.jsonl", "w") as f:
+        f.write(json.dumps({"kind": "run_start", "t": 0.0,
+                            "step": 0}) + "\n")
+        i = 0
+        for tenant, ts in ttfts.items():
+            for ttft in ts:
+                f.write(json.dumps(
+                    {"kind": "serving_trace", "t": float(i),
+                     "id": f"{tenant}-{i}", "tenant": tenant,
+                     "outcome": "finished", "prompt_tokens": 8,
+                     "new_tokens": 4, "queue_wait_s": 0.001,
+                     "ttft_s": ttft, "e2e_s": ttft + 0.03,
+                     "prefix_hit_tokens": 2, "tokens_discarded": 0,
+                     "spans": [
+                         {"ev": "queued", "t": 0.0},
+                         {"ev": "admitted", "t": 0.001, "slot": 0},
+                         {"ev": "prefill", "t": 0.005, "tokens": 8},
+                         {"ev": "decode", "t": ttft, "emitted": 4},
+                         {"ev": "finished", "t": ttft + 0.03},
+                     ]}) + "\n")
+                i += 1
+        f.write(json.dumps(
+            {"kind": "serving_trace", "t": float(i), "id": "chat-x",
+             "tenant": "chat", "outcome": "preempted",
+             "prompt_tokens": 8, "new_tokens": 2,
+             "queue_wait_s": 0.001, "ttft_s": 0.01, "e2e_s": None,
+             "prefix_hit_tokens": 0, "tokens_discarded": 2,
+             "spans": [{"ev": "queued", "t": 0.0},
+                       {"ev": "admitted", "t": 0.001, "slot": 1},
+                       {"ev": "preempted", "t": 0.02,
+                        "tokens_discarded": 2}]}) + "\n")
+    return run_dir
+
+
+def test_serving_report_cli_pinned(tmp_path, capsys):
+    """`--serving-report` on the synthetic fixture: nearest-rank
+    percentiles and SLO attainment are EXACT pins (chat n=5 ttfts
+    10..50ms all inside the 250ms deadline; docs 100..500ms with
+    only 100/200ms attaining), the preempted trace counts toward
+    preemptions/retry cost but never toward attainment."""
+    from distributed_training_tpu.telemetry.summarize import main
+
+    run_dir = str(_synthetic_serving_run(tmp_path))
+    assert main([run_dir, "--serving-report", "--json"]) == 0
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["traces"] == 11
+    chat, docs = rep["tenants"]["chat"], rep["tenants"]["docs"]
+    # Nearest-rank on n=5: p50 -> rank 3, p95/p99 -> rank 5.
+    assert chat["ttft_s"]["p50"] == 0.03
+    assert chat["ttft_s"]["p95"] == 0.05
+    assert chat["ttft_s"]["p99"] == 0.05
+    assert docs["ttft_s"]["p50"] == 0.3
+    assert docs["ttft_s"]["p99"] == 0.5
+    # conf deadlines: ttft 0.25, per-token 0.05 (decode tail 0.03
+    # over 3 post-first tokens attains everywhere).
+    assert chat["slo"] == {"attained": 1.0, "met": 5, "requests": 5,
+                           "ttft_deadline_s": 0.25,
+                           "per_token_deadline_s": 0.05}
+    assert docs["slo"]["attained"] == pytest.approx(0.4)
+    assert rep["overall"]["slo"]["attained"] == pytest.approx(0.7)
+    assert rep["overall"]["slo"]["requests"] == 10
+    assert chat["preemptions"] == 1
+    assert chat["tokens_discarded"] == 2
+    # Hit rate is over FINISHED prompts (20 hit / 80 prompt tokens);
+    # the preempted trace's prompt never counts.
+    assert rep["overall"]["prefix_hit_rate"] == pytest.approx(0.25)
+    # CLI deadline override wins over the conf block.
+    assert main([run_dir, "--serving-report", "--json",
+                 "--slo-ttft-s", "0.15"]) == 0
+    rep2 = json.loads(capsys.readouterr().out)
+    assert rep2["tenants"]["docs"]["slo"]["attained"] == \
+        pytest.approx(0.2)
+    assert rep2["overall"]["slo"]["attained"] == \
+        pytest.approx(0.6)
+    # Human rendering names every tenant.
+    assert main([run_dir, "--serving-report"]) == 0
+    out = capsys.readouterr().out
+    assert "chat" in out and "docs" in out
+    # A run dir with no serving_trace records refuses politely.
+    assert main([str(_synthetic_run_dir(tmp_path)),
+                 "--serving-report"]) == 1
+
+
+def test_summarizer_includes_serving_section(tmp_path):
+    """The plain summarizer report grows a serving section when the
+    run dir holds serving_trace records — same analyzer as the
+    dedicated --serving-report path."""
+    from distributed_training_tpu.telemetry.summarize import (
+        render, summarize_run)
+
+    s = summarize_run(str(_synthetic_serving_run(tmp_path)))
+    assert s["serving"]["traces"] == 11
+    assert "chat" in s["serving"]["tenants"]
+    text = render(s)
+    assert "serving" in text and "chat" in text
