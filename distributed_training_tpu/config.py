@@ -219,6 +219,23 @@ class TrainConfig:
     # threshold). Fed from the same Telemetry sink as events.jsonl —
     # one metrics source of truth. 0 disables.
     metrics_port: int = 0
+    # Online anomaly detection + incident flight recorder (telemetry/
+    # anomaly.py, telemetry/incident.py). The detector is a pure
+    # host-side observer of the event stream (zero new device syncs):
+    # rolling median/MAD baselines over step_time / data_wait /
+    # throughput / loss / serving signals, `anomaly` events with
+    # evidence, a sustained step-time regression arming one in-run
+    # profile capture (drops `profile_now`, one-shot across restarts),
+    # and incident bundles under <run_dir>/incidents/ on anomaly /
+    # watchdog abort / preemption. Coordinator-only. Offline triage:
+    # `python -m distributed_training_tpu.telemetry <run_dir> --doctor`.
+    anomaly_detect: bool = True
+    anomaly_window: int = 64      # rolling baseline window (samples)
+    anomaly_min_samples: int = 16  # baseline warmup before verdicts
+    anomaly_threshold: float = 8.0  # MADs from median to flag
+    anomaly_sustain: int = 5      # consecutive slow steps -> profile
+    anomaly_autoprofile: bool = True  # arm profile_now on sustained
+    incident_cooldown_s: float = 60.0  # min gap between bundles/kind
     # Deterministic fault injection (resilience/faults.py): e.g.
     # "crash@40,sigterm@80,corrupt_ckpt@120,data_stall@60:500ms".
     # Every trigger is a pure function of the global step (multi-host

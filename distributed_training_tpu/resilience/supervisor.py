@@ -432,6 +432,24 @@ def supervise(run_incarnation: Callable[[dict[str, str]], object],
                                 incarnations=len(result.incidents),
                                 streak=streak, outcome=outcome,
                                 returncode=rc)
+                if telemetry.events_jsonl:
+                    # The crash-loop give-up is exactly the moment a
+                    # human gets paged: leave a flight-recorder bundle
+                    # next to the events stream (lazy import keeps the
+                    # parent telemetry-free until this terminal path).
+                    from distributed_training_tpu.telemetry.incident \
+                        import write_incident_bundle
+                    write_incident_bundle(
+                        os.path.join(
+                            os.path.dirname(telemetry.events_jsonl),
+                            "incidents"),
+                        reason=("crash-loop: no checkpoint progress in "
+                                f"the last {streak} attempt(s)"),
+                        kind="give_up",
+                        events_tail=telemetry.tail(),
+                        extra={"incarnations": len(result.incidents),
+                               "streak": streak, "outcome": outcome,
+                               "returncode": rc})
             _notify(incident)
             return result
         delay = policy.backoff_s(streak) if streak else 0.0

@@ -53,6 +53,38 @@ import numpy as np
 logger = logging.getLogger(__name__)
 
 
+def debug_requests_snapshot(engine) -> dict:
+    """In-flight request table — the ``/debug/requests`` body.
+
+    Engine bookkeeping only — slot table + page tables, zero device
+    touch. Best-effort snapshot: the engine thread mutates slots
+    between reads, so a sequence finishing mid-render is simply
+    absent. Module-level so the incident recorder can capture the
+    same snapshot into a bundle without going through HTTP."""
+    reqs = []
+    for s in list(engine.slots):
+        if s is None:
+            continue
+        try:
+            reqs.append({
+                "id": s.req.id,
+                "tenant": s.req.tenant,
+                "group": engine.group_of_slot(s.slot),
+                "slot": s.slot,
+                "prompt_tokens": s.prompt_len,
+                "prefilled": s.prefilled,
+                "generated": len(s.generated),
+                "pages_held":
+                    engine.cache.pages_of(s.req.id),
+                "session": s.req.session})
+        except KeyError:
+            continue  # freed between reads
+    return {
+        "in_flight": len(reqs),
+        "queue_depth": len(engine.queue),
+        "requests": reqs}
+
+
 class ServingServer:
     """HTTP front + engine thread over a built Engine."""
 
@@ -82,6 +114,11 @@ class ServingServer:
         self.metrics = MetricsServer(
             metrics_port if metrics_port is not None else 0,
             telemetry=telemetry)
+
+    def debug_snapshot(self) -> dict:
+        """The ``/debug/requests`` body, callable in-process — the
+        incident recorder's ``serving_snapshot`` hook."""
+        return debug_requests_snapshot(self.engine)
 
     # -- engine thread -----------------------------------------------------
 
@@ -394,33 +431,7 @@ class ServingServer:
                     self.wfile.write(body)
                     return
                 if path == "/debug/requests":
-                    # Engine bookkeeping only — slot table + page
-                    # tables, zero device touch. Best-effort
-                    # snapshot: the engine thread mutates slots
-                    # between reads, so a sequence finishing mid-
-                    # render is simply absent.
-                    reqs = []
-                    for s in list(eng.slots):
-                        if s is None:
-                            continue
-                        try:
-                            reqs.append({
-                                "id": s.req.id,
-                                "tenant": s.req.tenant,
-                                "group": eng.group_of_slot(s.slot),
-                                "slot": s.slot,
-                                "prompt_tokens": s.prompt_len,
-                                "prefilled": s.prefilled,
-                                "generated": len(s.generated),
-                                "pages_held":
-                                    eng.cache.pages_of(s.req.id),
-                                "session": s.req.session})
-                        except KeyError:
-                            continue  # freed between reads
-                    self._reply(200, {
-                        "in_flight": len(reqs),
-                        "queue_depth": len(eng.queue),
-                        "requests": reqs})
+                    self._reply(200, debug_requests_snapshot(eng))
                     return
                 self._reply(404, {"error": "try /healthz, /metrics "
                                            "or /debug/requests"})

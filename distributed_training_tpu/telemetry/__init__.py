@@ -23,6 +23,17 @@ One instrumentation surface, four consumers:
 - ``MetricsServer`` (metrics_server.py) — the coordinator's live
   Prometheus endpoint + /healthz, fed from this sink (plus the
   tenant-labeled serving latency histograms);
+- ``AnomalyDetector`` (anomaly.py) — online median/MAD anomaly
+  detection over the same event stream (registered through
+  ``add_observer`` like the metrics server: pure host-side, zero
+  device syncs), arming an in-run profile capture on sustained
+  step-time regressions;
+- ``IncidentRecorder``/``write_incident_bundle`` (incident.py) —
+  flight-recorder incident bundles (event tail + anomaly verdict +
+  latest attribution + serving snapshot) written atomically under
+  ``<run_dir>/incidents/``; watchdog postmortems share the format;
+- the offline doctor (doctor.py) — rule-based classification of a
+  run dir or incident bundle (``--doctor``);
 - ``analyze_traces`` (serving_trace.py) — per-tenant SLO ledger
   reconstructed offline from the serving engine's ``serving_trace``
   request-lifecycle records (``--serving-report``);
@@ -34,6 +45,9 @@ all (summarize.py; multi-host run dirs get the merged report). Event
 schema and bucket definitions: docs/observability.md.
 """
 
+from distributed_training_tpu.telemetry.anomaly import (  # noqa: F401
+    AnomalyDetector,
+)
 from distributed_training_tpu.telemetry.attribution import (  # noqa: F401
     ProfileCapture,
     hlo_overlap_report,
@@ -54,6 +68,10 @@ from distributed_training_tpu.telemetry.goodput import (  # noqa: F401
 )
 from distributed_training_tpu.telemetry.hbm import (  # noqa: F401
     HBMSampler,
+)
+from distributed_training_tpu.telemetry.incident import (  # noqa: F401
+    IncidentRecorder,
+    write_incident_bundle,
 )
 from distributed_training_tpu.telemetry.metrics_server import (  # noqa: F401
     MetricsServer,

@@ -102,6 +102,10 @@ class MetricsServer:
         # (the per-dp-group serving gauges; rendered as
         # dtt_<name>{group="N"} rows, additive next to the flat set).
         self._labeled: dict[str, dict[str, float]] = {}
+        # Labeled COUNTER families (anomalies by signal): same label
+        # layout, rendered with TYPE counter — a separate dict because
+        # the exposition format pins one TYPE per family.
+        self._labeled_counters: dict[str, dict[str, float]] = {}
         # Histogram families: name -> {tenant -> state}. Bounds are
         # the module-level HIST_BUCKETS; state is cumulative-ready
         # (per-bound counts + sum + count, +Inf implied by count).
@@ -279,6 +283,28 @@ class MetricsServer:
                               (int, float)):
                     self._gauges["serving_sessions_resident"] = \
                         float(rec["sessions_resident"])
+            elif kind == "anomaly":
+                # Online-detector verdicts (telemetry/anomaly.py) —
+                # one counter per signal so an alert rule can key on
+                # dtt_anomalies_total{kind="step_time"}.
+                sig = rec.get("signal")
+                if isinstance(sig, str) and sig:
+                    fam = self._labeled_counters.setdefault(
+                        "anomalies_total", {})
+                    key = f'kind="{sig}"'
+                    fam[key] = fam.get(key, 0.0) + 1
+            elif kind == "anomaly_baseline":
+                # Low-cadence rolling-baseline snapshots: what the
+                # detector currently considers normal.
+                for src, dst in (
+                        ("step_time_s", "anomaly_baseline_step_time_s"),
+                        ("data_wait_s",
+                         "anomaly_baseline_data_wait_s")):
+                    if isinstance(rec.get(src), (int, float)):
+                        self._gauges[dst] = float(rec[src])
+            elif kind == "incident":
+                self._counters["incidents_total"] = \
+                    self._counters.get("incidents_total", 0.0) + 1
             elif kind == "serving_kv":
                 # Allocator records: keep occupancy live even between
                 # engine steps (join/evict happen inside steps, but
@@ -453,6 +479,14 @@ class MetricsServer:
         "serving_sessions_resident": "Retained chat sessions holding "
                                      "KV pages for zero-prefill "
                                      "resume",
+        "anomalies_total": "Online anomaly-detector verdicts by "
+                           "signal (telemetry/anomaly.py)",
+        "incidents_total": "Incident bundles written by the flight "
+                           "recorder (telemetry/incident.py)",
+        "anomaly_baseline_step_time_s": "Detector rolling-median "
+                                        "step-time baseline",
+        "anomaly_baseline_data_wait_s": "Detector rolling-median "
+                                        "data-wait baseline",
     }
 
     def render(self) -> str:
@@ -461,6 +495,8 @@ class MetricsServer:
             gauges = dict(self._gauges)
             counters = dict(self._counters)
             labeled = {k: dict(v) for k, v in self._labeled.items()}
+            labeled_counters = {k: dict(v) for k, v in
+                                self._labeled_counters.items()}
             hists = {name: {t: {"counts": list(st["counts"]),
                                 "sum": st["sum"],
                                 "count": st["count"]}
@@ -484,6 +520,12 @@ class MetricsServer:
             lines.append(f"# HELP {full} {self._HELP.get(name, name)}")
             lines.append(f"# TYPE {full} counter")
             lines.append(f"{full} {_fmt(value)}")
+        for name, fam in sorted(labeled_counters.items()):
+            full = f"dtt_{name}"
+            lines.append(f"# HELP {full} {self._HELP.get(name, name)}")
+            lines.append(f"# TYPE {full} counter")
+            for labels, value in sorted(fam.items()):
+                lines.append(f"{full}{{{labels}}} {_fmt(value)}")
         for name, fam in sorted(hists.items()):
             full = f"dtt_{name}"
             bounds = HIST_BUCKETS[name]

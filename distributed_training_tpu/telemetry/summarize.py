@@ -515,6 +515,13 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--write-merged", default=None, metavar="PATH",
                    help="multi-host only: also write the merged, "
                         "clock-aligned event timeline as jsonl")
+    p.add_argument("--doctor", action="store_true",
+                   help="rule-based diagnosis of a run dir OR an "
+                        "incident bundle: classify input-bound / "
+                        "exposed-comms / compute-bound / straggler / "
+                        "data-skip storm / preemption thrash / "
+                        "serving SLO breach, citing the exact "
+                        "events and attribution fractions")
     p.add_argument("--serving-report", action="store_true",
                    help="print ONLY the serving SLO ledger "
                         "reconstructed from serving_trace records "
@@ -531,6 +538,19 @@ def main(argv: list[str] | None = None) -> int:
     if not os.path.isdir(args.run_dir):
         print(f"not a directory: {args.run_dir}", file=sys.stderr)
         return 2
+    if args.doctor:
+        from distributed_training_tpu.telemetry.doctor import (
+            diagnose_path, render_doctor)
+        slo = None
+        if (args.slo_ttft_s is not None
+                and args.slo_per_token_s is not None):
+            slo = (args.slo_ttft_s, args.slo_per_token_s)
+        report = diagnose_path(args.run_dir, slo=slo)
+        if args.json:
+            print(json.dumps(report))
+        else:
+            print(render_doctor(report))
+        return 0
     if args.serving_report:
         from distributed_training_tpu.telemetry.serving_trace import (
             render_serving_lines, slo_deadlines_from_conf)

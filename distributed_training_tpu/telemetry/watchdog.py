@@ -70,48 +70,19 @@ def write_postmortem(base_dir: str, reason: str,
                      extra: dict | None = None) -> str:
     """Write one timestamped postmortem bundle; returns its path.
 
-    Contents: ``meta.json`` (reason, pid, time, extra), ``stacks.txt``
-    (all-thread tracebacks), ``memory_stats.json`` (per-device), and
-    ``events_tail.jsonl`` (the last N telemetry events, when given).
+    Since the incident flight recorder landed, a postmortem IS an
+    incident bundle (``kind="watchdog"``): this delegates to
+    ``telemetry.incident.write_incident_bundle``, so postmortems and
+    anomaly/preemption/give-up incidents share one on-disk format
+    (meta.json with schema+kind, stacks.txt, events_tail.jsonl,
+    memory_stats.json) and the offline ``--doctor`` reads either.
     Never raises — a postmortem writer that can crash its host process
     is worse than no postmortem."""
-    stamp = time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
-    path = os.path.join(
-        base_dir, f"{stamp}_pid{os.getpid()}_{next(_SEQ)}")
-    try:
-        os.makedirs(path, exist_ok=True)
-        with open(os.path.join(path, "meta.json"), "w") as f:
-            json.dump({"reason": reason, "time_unix": time.time(),
-                       "pid": os.getpid(), **(extra or {})}, f,
-                      indent=1)
-        with open(os.path.join(path, "stacks.txt"), "w") as f:
-            faulthandler.dump_traceback(file=f, all_threads=True)
-        # noqa'd DTT001: a postmortem COPY of already-emitted records,
-        # not an emission path — host tags are already on the records.
-        with open(os.path.join(path, "events_tail.jsonl"), "w") as f:  # noqa: DTT001
-            for rec in events_tail or []:
-                f.write(json.dumps(rec) + "\n")
-        # memory_stats queries the backend — the component that may be
-        # wedged. Collect it in a bounded daemon thread so a hung query
-        # can never block the caller (bench's budget timers os._exit
-        # right after this; a postmortem that hangs its own escape
-        # hatch is worse than a missing memory_stats.json — and an
-        # absent/empty file is itself a finding: the backend didn't
-        # answer).
-        def _dump_memory():
-            stats = _device_memory_stats()
-            with open(os.path.join(path, "memory_stats.json"),
-                      "w") as f:
-                json.dump(stats, f, indent=1)
-        t = threading.Thread(target=_dump_memory, daemon=True,
-                             name="postmortem-memory-stats")
-        t.start()
-        t.join(timeout=10)
-    except Exception as e:  # noqa: BLE001 — never raises (docstring);
-        # best-effort breadcrumb only (DTT002: no silent swallows).
-        logger.debug("postmortem bundle incomplete at %s: %s: %s",
-                     path, type(e).__name__, e)
-    return path
+    from distributed_training_tpu.telemetry.incident import (
+        write_incident_bundle)
+    return write_incident_bundle(base_dir, reason=reason,
+                                 kind="watchdog",
+                                 events_tail=events_tail, extra=extra)
 
 
 class HangWatchdog:

@@ -230,6 +230,16 @@ def main(argv: list[str] | None = None) -> int:
         resumed = checkpointer.latest_step() is not None
         restart_count = int(os.environ.get(
             sup.ENV_RESTART_COUNT, "0") or 0)
+        # Restored events for the anomaly detector's baseline replay,
+        # read BEFORE the Telemetry below opens the stream (a fresh
+        # run truncates it; a resumed run appends a new run_start —
+        # either way the pre-restart records must be captured first).
+        restored_events: list = []
+        if (cfg.train.anomaly_detect and rt.is_coordinator
+                and (resumed or restart_count > 0)):
+            from distributed_training_tpu.telemetry.summarize import (
+                load_jsonl)
+            restored_events = load_jsonl(cfg.train.events_jsonl)
         # fresh only on a genuinely first incarnation: a supervised
         # restart that found NO checkpoint (crash before the first
         # save) must APPEND — truncating would destroy the crashed
@@ -247,6 +257,40 @@ def main(argv: list[str] | None = None) -> int:
         # what lets the offline aggregator put N host clocks on one
         # axis.
         tel.event("clock_sync", **rt.clock_sync_record())
+        # Closed-loop diagnostics (telemetry/anomaly.py + incident.py),
+        # coordinator-only: the online detector keeps rolling
+        # median/MAD baselines over the event stream (pure host-side
+        # observer — zero new device syncs), a sustained step-time
+        # regression arms one in-run profile capture via the
+        # profile_now drop file, and the incident recorder snapshots
+        # the flight-recorder ring buffer into
+        # <run_dir>/incidents/<ts>/ on anomaly / watchdog abort /
+        # preemption. Baselines are rebuilt deterministically from the
+        # restored stream on resume.
+        detector = None
+        incidents = None
+        if cfg.train.anomaly_detect and rt.is_coordinator:
+            from distributed_training_tpu.telemetry.anomaly import (
+                AnomalyDetector)
+            from distributed_training_tpu.telemetry.incident import (
+                IncidentRecorder)
+            detector = AnomalyDetector(
+                telemetry=tel, run_dir=run_dir,
+                window=cfg.train.anomaly_window,
+                min_samples=cfg.train.anomaly_min_samples,
+                threshold=cfg.train.anomaly_threshold,
+                sustain=cfg.train.anomaly_sustain,
+                autoprofile=cfg.train.anomaly_autoprofile,
+                host=rt.process_index)
+            if restored_events:
+                n = detector.replay(restored_events)
+                logger.info("anomaly baselines rebuilt from %d "
+                            "restored event(s)", n)
+            incidents = IncidentRecorder(
+                run_dir, telemetry=tel, detector=detector,
+                cooldown_s=cfg.train.incident_cooldown_s)
+            tel.add_observer(detector.observe)
+            tel.add_observer(incidents.observe)
         watchdog = None
         if cfg.train.watchdog_timeout_s > 0:
             watchdog = telemetry_lib.HangWatchdog(
@@ -344,6 +388,14 @@ def main(argv: list[str] | None = None) -> int:
             else:
                 summary = trainer.train()
         finally:
+            if incidents is not None and guard.should_stop:
+                # Preemption incident: the drain path saved a final
+                # checkpoint; the bundle records what the run looked
+                # like when the platform pulled the machine.
+                incidents.record(
+                    "preemption",
+                    reason="preemption/stop signal observed; "
+                           "stopping at a checkpoint boundary")
             if watchdog is not None:
                 watchdog.stop()
             if metrics_server is not None:

@@ -432,6 +432,14 @@ class Trainer:
             jsonl_path=tcfg.metrics_jsonl or None,
             jsonl_fresh=(restored is None),
             start_step=self.global_step,
+            # Mirror every metrics entry into the event stream as a
+            # ``train_metrics`` record: the anomaly detector's
+            # loss/throughput signals ride the loss float this logger
+            # already materializes at log_every cadence — zero NEW
+            # device syncs. Late-bound: _bind_telemetry re-resolves
+            # the ambient sink at train(), so emit through it then.
+            on_entry=lambda entry: self.telemetry.event(
+                "train_metrics", **entry),
         )
 
         # HBM cross-check input: the exact per-device state residency
@@ -582,6 +590,16 @@ class Trainer:
         # blocked on) the dispatch path — see telemetry/goodput.py.
         name = "compile" if self._steps_dispatched == 0 else "step"
         with self.telemetry.span(name, step=self.global_step + 1):
+            if self.faults is not None:
+                # slow_host fault: the injected degradation must land
+                # INSIDE the measured step region — the span (so the
+                # goodput ledger and the anomaly detector see the
+                # degraded step time) and the straggler detector's
+                # timing window both cover this call. A pure
+                # host-local sleep — no collective.
+                delay_s = self.faults.step_delay(self.global_step + 1)
+                if delay_s:
+                    time.sleep(delay_s)
             if self._offload:
                 # Stream the moments host->device for the compiled
                 # step and back to their pinned-host residency after —
@@ -755,14 +773,6 @@ class Trainer:
                         self.watchdog.disarm()
                     break
                 t_step0 = time.perf_counter()
-                if self.faults is not None:
-                    # slow_host fault: the injected degradation must land
-                    # INSIDE the measured step region so the straggler
-                    # detector attributes it exactly like a real slow
-                    # host. A pure host-local sleep — no collective.
-                    delay_s = self.faults.step_delay(self.global_step + 1)
-                    if delay_s:
-                        time.sleep(delay_s)
                 metrics = self.train_step(batch)
                 if self.straggler.enabled:
                     self.straggler.record_step(

@@ -82,6 +82,13 @@ class MetricsLogger:
     jsonl_path: str | None = None
     jsonl_fresh: bool = True
     start_step: int = 0
+    # Optional callback invoked with every appended entry dict. The
+    # entry is already fully host-side (the loss float above is the
+    # one device sync, and it happens regardless) — the trainer wires
+    # this to re-emit entries as ``train_metrics`` telemetry events so
+    # the anomaly detector sees loss/throughput with ZERO new syncs.
+    # Exceptions are swallowed: a consumer must not break logging.
+    on_entry: object = None
 
     # None until the first record(): the throughput window starts at
     # the first recorded row, NOT at construction — the gap between
@@ -112,12 +119,19 @@ class MetricsLogger:
 
     def _append(self, entry: dict) -> None:
         self.history.append(entry)
-        if not self.jsonl_path:
-            return
-        import json
-        with open(self.jsonl_path, "a") as f:
-            f.write(json.dumps(sanitize_for_json(entry),
-                               allow_nan=False) + "\n")
+        if self.jsonl_path:
+            import json
+            with open(self.jsonl_path, "a") as f:
+                f.write(json.dumps(sanitize_for_json(entry),
+                                   allow_nan=False) + "\n")
+        if self.on_entry is not None:
+            try:
+                self.on_entry(sanitize_for_json(entry))
+            except Exception as e:  # noqa: BLE001 — an observer must
+                # not take down the metrics path (telemetry observer
+                # discipline).
+                logger.debug("metrics on_entry failed: %s: %s",
+                             type(e).__name__, e)
 
     def record(self, step: int, metrics: dict, epoch: int = 0) -> None:
         if not self.enabled or self.log_every <= 0:

@@ -57,6 +57,19 @@ def test_repo_passes_planner_check():
     assert out.returncode == 0, out.stdout + "\n" + out.stderr
 
 
+def test_repo_passes_perf_ledger_check():
+    """The perf-ledger gate: every committed *_r*.json ledger chain
+    (compared_to copies, speedup gates, revision contiguity) still
+    reproduces. Stdlib-only and invoked BY PATH like lint_local —
+    no package import, no jax. tests/test_perf_ledger.py owns the
+    red cases on tampered copies; this is the tier-1 wiring."""
+    out = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "tools", "perf_ledger.py"), "--check"],
+        capture_output=True, text=True, timeout=600, cwd=REPO)
+    assert out.returncode == 0, out.stdout + "\n" + out.stderr
+
+
 def test_lint_and_analysis_share_one_rule_table():
     """lint_local must run the registry, not a private copy — the
     two gates drifting is the failure mode the refactor removes."""
