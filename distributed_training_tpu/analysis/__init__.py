@@ -8,7 +8,7 @@ Two halves, one gate (``python -m distributed_training_tpu.analysis
   simulated mesh, flag involuntary-reshard cliffs, unattributed
   collectives, and replicated large params; ratchet against the
   committed ``spmd_baseline.json`` so only NEW findings fail.
-- ``pitfalls.py``: the DTT00x AST rule registry (host syncs in the
+- ``pitfalls.py``: the DTT0xx AST rule registry (host syncs in the
   step loop, host-local collective guards, PRNG key reuse, undonated
   train steps, ...), shared with ``tools/lint_local.py``.
 

@@ -1,6 +1,6 @@
 """CLI: ``python -m distributed_training_tpu.analysis [--check]``.
 
-Runs the JAX-pitfall rules (DTT00x) over the repo and the SPMD audit
+Runs the JAX-pitfall rules (DTT0xx) over the repo and the SPMD audit
 over every named target, writes ``spmd_audit.json`` (``schema: 1``),
 prints the human report, and — under ``--check`` — exits nonzero on
 any rule violation or any audit finding NOT in the committed baseline
@@ -35,7 +35,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.dirname(
 
 
 def run_rules(repo: str = REPO) -> list[str]:
-    """DTT00x pitfall rules over every repo file (tests exempt; walk
+    """DTT0xx pitfall rules over every repo file (tests exempt; walk
     and skip set shared with tools/lint_local.py via pitfalls)."""
     from distributed_training_tpu.analysis import pitfalls
     problems: list[str] = []

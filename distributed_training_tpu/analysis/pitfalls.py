@@ -1,4 +1,4 @@
-"""AST-level JAX-pitfall lint rules (DTT00x) as a registry.
+"""AST-level JAX-pitfall lint rules (DTT0xx) as a registry.
 
 Each rule encodes a discipline the codebase otherwise keeps only by
 convention — and conventions are exactly what the next contributor
@@ -57,6 +57,11 @@ Rule catalog (details in docs/static-analysis.md):
   (``Engine._fetch_host``, disagg's KV export/import) — the
   device-resident decode loop's whole point is ONE host sync per
   K-step burst, and a stray sync re-serializes the loop per token.
+- DTT011 serving params rebinding: ``<obj>.params = ...`` in
+  ``serving/`` outside ``Engine.__init__``/``Engine.swap_weights``
+  (and ``WeightStore.__init__``) — live weights change only through
+  the swap path's validated, plan-sharded, atomic install; a bare
+  rebinding skips every gate.
 """
 
 from __future__ import annotations
@@ -856,3 +861,73 @@ def _check_serving_host_sync(ctx: FileContext):
                "— route fetches through the designated sync helper "
                "(engine._fetch_host / disagg KV export-import); "
                "host-side conversions use np.array")
+
+
+# ---------------------------------------------------------------------------
+# DTT011 — params rebinding outside swap_weights
+# ---------------------------------------------------------------------------
+
+# Live weight hot-swap (Engine.swap_weights) is the ONE sanctioned
+# place serving weights change: it validates treedef/shape/dtype/
+# provenance, places every leaf on the committed plan's sharding, and
+# installs atomically (all gates before the first write). A stray
+# `something.params = ...` anywhere else in serving/ bypasses every
+# one of those gates — half-installed weights, silent sharding
+# mismatches, recompiles — so the rebinding itself is the lint target.
+# Reads of `.params` and local variables NAMED params stay legal; only
+# attribute REBINDING is flagged.
+DTT011_SCOPED = (
+    os.path.join("distributed_training_tpu", "serving"),
+)
+DTT011_ALLOWED: dict[str, set[str]] = {
+    # Engine: construction + the swap path itself.
+    os.path.join("distributed_training_tpu", "serving", "engine.py"):
+        {"__init__", "swap_weights"},
+    # WeightStore: loads the artifact's params at construction.
+    os.path.join("distributed_training_tpu", "serving", "disagg.py"):
+        {"__init__"},
+}
+
+
+@_rule("DTT011", "serving-params-rebinding",
+       "serving weights rebound outside Engine.swap_weights")
+def _check_serving_params_rebinding(ctx: FileContext):
+    """``<obj>.params = ...`` (or ``+=``) in ``serving/`` outside the
+    sanctioned sites (``Engine.__init__``/``Engine.swap_weights``,
+    ``WeightStore.__init__``) installs weights without the swap path's
+    gates — no treedef/shape/dtype check, no provenance match, no
+    plan-sharding placement, no atomicity. The hot-swap contract
+    (docs/robustness.md, serving resilience) holds only while
+    ``swap_weights`` is the single writer."""
+    if not any(ctx.rel.startswith(p + os.sep) or ctx.rel == p
+               for p in DTT011_SCOPED):
+        return
+    allowed = DTT011_ALLOWED.get(ctx.rel, set())
+
+    def _enclosing_fn(node):
+        for anc in ctx.ancestors(node):
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return anc
+        return None
+
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            targets = [node.target]
+        else:
+            continue
+        for tgt in targets:
+            if not (isinstance(tgt, ast.Attribute)
+                    and tgt.attr == "params"):
+                continue
+            fn = _enclosing_fn(node)
+            if fn is not None and fn.name in allowed:
+                continue
+            where = f"`{fn.name}`" if fn is not None else \
+                "module scope"
+            yield (node.lineno,
+                   f"`.params` rebound in {where} — weights change "
+                   "ONLY through Engine.swap_weights (validated, "
+                   "plan-sharded, atomic); a bare rebinding skips "
+                   "every swap gate")

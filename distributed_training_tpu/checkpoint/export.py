@@ -92,6 +92,13 @@ def _plan_provenance(ckpt_dir: str, plan: str | None) -> dict | None:
     return {"name": p.name, "fingerprint": p.fingerprint()}
 
 
+# Public name: callers publishing weights at runtime (the hot-swap
+# path — Engine.swap_weights provenance gate) need the same stamp the
+# export CLI writes, from the same implementation, so the two can
+# never disagree. The underscore name stays for the existing pins.
+plan_provenance = _plan_provenance
+
+
 def export(ckpt_dir: str, out_path: str, step: int | None = None,
            plan: str | None = None,
            quantize: str | None = None) -> dict:

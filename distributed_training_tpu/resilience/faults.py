@@ -13,6 +13,8 @@ Grammar (docs/robustness.md)::
     entry   := kind "@" step (":" modifier)*
     kind    := crash | sigterm | corrupt_ckpt | data_stall | data_error
              | data_corrupt | source_stall | lose_host | slow_host
+             | engine_crash | swap_corrupt | slow_decode
+             | client_disconnect                  # serving kinds
     modifier:= "always" | duration | "host=" K    # duration: "500ms"
              | "source=" NAME | "skip" | "fatal"  # source-level kinds
 
@@ -55,6 +57,24 @@ Grammar (docs/robustness.md)::
   restart (the degraded host was evicted — its replacement at the
   same index must not inherit the slowdown).
 
+Serving kinds trigger on the engine LAUNCH COUNT (one per non-idle
+``Engine.step`` — the serving analogue of the global step) through the
+engine's ``on_launch``/``on_swap`` hooks:
+
+- ``engine_crash@12``  raise ``InjectedCrash`` out of ``Engine.step``
+  after launch 12 (recovery = the serving supervisor's in-process
+  restart + KV re-adoption, resilience/supervisor.py
+  ``supervise_serving``).
+- ``swap_corrupt@12``  the first ``Engine.swap_weights`` publish at or
+  after launch 12 fails verification and is REFUSED whole — the
+  incumbent weights keep serving (at-or-after: swaps are sparse).
+- ``slow_decode@12:50ms`` sleep 50ms between launches 12 and 13 — a
+  one-shot degraded step (drain-deadline and SLO-attribution drills),
+  not the persistent ``slow_host`` shape.
+- ``client_disconnect@12`` drop one live stream listener after launch
+  12 (the severed-client shape; the engine finishes the request and
+  the exactly-once high-water mark keeps the stream consistent).
+
 Host-targeted faults keep the every-host-same-loop-point discipline:
 every host evaluates the trigger; only the host whose process index
 matches ``host=K`` acts, and the action never involves a collective.
@@ -87,8 +107,15 @@ from distributed_training_tpu.resilience.elastic import (
 
 logger = logging.getLogger(__name__)
 
+# Serving kinds key on the ENGINE LAUNCH COUNT (the serving analogue
+# of the global step — one per non-idle ``Engine.step``): the engine's
+# ``on_launch``/``on_swap`` hooks evaluate them (serving/engine.py),
+# same write-before-action ledger as the trainer kinds.
+SERVING_KINDS = ("engine_crash", "swap_corrupt", "slow_decode",
+                 "client_disconnect")
 KINDS = ("crash", "sigterm", "corrupt_ckpt", "data_stall", "data_error",
-         "data_corrupt", "source_stall", "lose_host", "slow_host")
+         "data_corrupt", "source_stall", "lose_host",
+         "slow_host") + SERVING_KINDS
 # Kinds that target one host (require a host= modifier).
 HOST_KINDS = ("lose_host", "slow_host")
 # Kinds that act inside a single mixture source's read path (accept a
@@ -208,12 +235,12 @@ def parse_fault_plan(spec: str) -> tuple[Fault, ...]:
             else:
                 stall_s = parse_duration_s(tok)
         if stall_s and kind not in ("data_stall", "slow_host",
-                                    "source_stall"):
+                                    "source_stall", "slow_decode"):
             raise FaultPlanError(
                 f"duration modifier only applies to data_stall/"
-                f"slow_host/source_stall, got {entry!r}")
-        if kind in ("data_stall", "slow_host", "source_stall") \
-                and not stall_s:
+                f"slow_host/source_stall/slow_decode, got {entry!r}")
+        if kind in ("data_stall", "slow_host", "source_stall",
+                    "slow_decode") and not stall_s:
             raise FaultPlanError(
                 f"{kind} needs a duration, e.g. "
                 f"'{kind}@{step}:500ms' (got {entry!r})")
@@ -373,6 +400,42 @@ class FaultInjector:
             self._record(f)
             raise InjectedCrash(
                 f"injected crash at global step {global_step}")
+
+    def on_launch(self, launch: int) -> list[str]:
+        """Serving engine hook, after launch ``launch``'s step record
+        is emitted (serving/engine.py ``_run_faults``). Performs the
+        self-contained action (``slow_decode`` sleeps here — a
+        degraded-step blip, not a degraded host) and returns the
+        fired kinds whose action needs engine state
+        (``client_disconnect``, ``engine_crash`` — graceful recorded
+        before lethal, so a plan scheduling both at one launch
+        ledgers both even though the crash ends the incarnation)."""
+        fired: list[str] = []
+        for f in self._due(launch, ("slow_decode",)):
+            self._record(f, stall_s=f.stall_s, launch=launch)
+            fired.append(f.kind)
+            time.sleep(f.stall_s)
+        for f in self._due(launch, ("client_disconnect",)):
+            self._record(f, launch=launch)
+            fired.append(f.kind)
+        for f in self._due(launch, ("engine_crash",)):
+            self._record(f, launch=launch)
+            fired.append(f.kind)
+        return fired
+
+    def on_swap(self, launch: int) -> bool:
+        """Weight-swap hook (``Engine.swap_weights``): True when an
+        armed ``swap_corrupt`` makes THIS publish fail verification.
+        At-or-after semantics (the ``corrupt_ckpt`` precedent): swaps
+        are sparse, an exact launch-count match would usually never
+        fire. The ledger write precedes the refusal it causes."""
+        for f in self.plan:
+            if (f.kind != "swap_corrupt" or launch < f.step
+                    or (not f.always and f.key in self.fired)):
+                continue
+            self._record(f, fired_at=launch)
+            return True
+        return False
 
     def step_delay(self, global_step: int) -> float:
         """Seconds this host must stall inside the measured region of

@@ -477,3 +477,180 @@ def supervise(run_incarnation: Callable[[dict[str, str]], object],
         if delay > 0:
             sleep(delay)
         incarnation += 1
+
+
+# ---------------------------------------------------------------------------
+# serving supervision (in-process engine restarts)
+# ---------------------------------------------------------------------------
+
+
+def supervise_serving(make_engine: Callable[[], object],
+                      run: Callable[[object, int], object],
+                      *,
+                      policy: RestartPolicy | None = None,
+                      incident_dir: str | None = None,
+                      sleep: Callable[[float], None] = time.sleep,
+                      snapshot: Callable[[], dict] | None = None
+                      ) -> dict:
+    """The serving analogue of ``supervise()``: restart a CRASHED
+    engine in-process, carrying the work across incarnations.
+
+    ``make_engine`` returns a fresh, warmed engine (attach a shared
+    ``FaultInjector`` instance — or one on a shared ledger path —
+    there, so a one-shot ``engine_crash@N`` cannot re-fire when the
+    successor's launch count passes N again); ``run(engine,
+    incarnation)`` drives it (submit on incarnation 0, then step/
+    drain) and returns the result that ends supervision.
+
+    On a crash out of ``run`` the dead engine's HOST-side state is
+    salvaged — an ``InjectedCrash``/engine-thread exception kills the
+    step loop, not the process, so queue, slots, listeners and the
+    emitted-token high-water marks are intact: in-flight sequences
+    with decoded tokens export their exact KV (``export_in_flight``)
+    and are RE-ADOPTED into the successor (nothing recomputed);
+    never-decoded ones and the queue resubmit fresh. The emission
+    state transfers wholesale, so a resubmitted stream regenerates
+    its greedy-identical prefix without re-delivering a single token
+    — exactly-once across the crash.
+
+    Budget rules are ``supervise()``'s with the serving progress
+    signal: an incarnation that FINISHED at least one request refunds
+    the budget; one that didn't burns one. Give-up (and every crash,
+    when ``incident_dir`` is set) leaves an incident bundle carrying
+    the ``/debug/requests`` snapshot and the last weight-swap
+    provenance, which the doctor classifies as
+    ``serving_engine_crash``."""
+    from distributed_training_tpu import telemetry as tel
+    from distributed_training_tpu.telemetry.incident import (
+        write_incident_bundle)
+
+    policy = policy or RestartPolicy()
+    engine = make_engine()
+    budget = policy.max_restarts
+    streak = 0
+    incarnation = 0
+    crashes: list[dict] = []
+    while True:
+        base_finished = engine.finished_total
+        try:
+            result = run(engine, incarnation)
+            return {"engine": engine, "result": result,
+                    "incarnations": incarnation + 1,
+                    "restarts": incarnation, "gave_up": False,
+                    "crashes": crashes}
+        except KeyboardInterrupt:
+            raise
+        except Exception as exc:  # noqa: BLE001 — the whole point:
+            # classify, salvage, restart (or give up on budget).
+            err = f"{type(exc).__name__}: {exc}"
+            logger.warning("serving engine crashed (incarnation %d, "
+                           "launch %d): %s", incarnation,
+                           getattr(engine, "launch_count", -1), err)
+            snap = None
+            try:
+                if snapshot is not None:
+                    snap = snapshot()
+                else:
+                    from distributed_training_tpu.serving.server \
+                        import debug_requests_snapshot
+                    snap = debug_requests_snapshot(engine)
+            except Exception as e:  # noqa: BLE001 — evidence layers
+                # are optional; a broken one must not stop recovery.
+                logger.debug("serving snapshot unavailable: %s", e)
+            emission = engine.export_emission_state()
+            queued = list(engine.queue)
+            engine.queue.clear()
+            try:
+                export = engine.export_in_flight()
+            except Exception as e:  # noqa: BLE001 — device state may
+                # be gone with the crash; restart those from the
+                # prompt (host-side request state is always intact).
+                logger.warning("in-flight KV salvage failed (%s); "
+                               "resubmitting from prompts", e)
+                export = {"adoptable": [],
+                          "requests": [engine._replay_request(s)
+                                       for s in engine.slots
+                                       if s is not None]}
+            advanced = engine.finished_total > base_finished
+            # Event BEFORE the bundle: the bundle's events_tail must
+            # contain the crash record the doctor keys on.
+            tel.event("serving_engine_crash", incarnation=incarnation,
+                      error=err,
+                      launches=getattr(engine, "launch_count", None),
+                      weights_version=engine.weights_version,
+                      kv_salvaged=len(export["adoptable"]),
+                      resubmitted=(len(export["requests"])
+                                   + len(queued)),
+                      finished_this_incarnation=(
+                          engine.finished_total - base_finished))
+            if incident_dir:
+                write_incident_bundle(
+                    incident_dir, reason=err, kind="engine_crash",
+                    events_tail=tel.current().tail(),
+                    extra={"incarnation": incarnation,
+                           "launch_count": getattr(
+                               engine, "launch_count", None),
+                           "weights_version": engine.weights_version,
+                           "weights_provenance":
+                               engine.weights_provenance,
+                           "swap_stats": dict(engine.swap_stats)},
+                    serving=snap)
+            crashes.append({"incarnation": incarnation, "error": err,
+                            "advanced": advanced})
+            if advanced:
+                budget = policy.max_restarts
+                streak = 0
+            else:
+                budget -= 1
+                streak += 1
+            if budget < 0:
+                logger.error(
+                    "serving supervisor: giving up after %d "
+                    "incarnation(s) — no finished request in the "
+                    "last %d attempt(s); last error %s",
+                    incarnation + 1, streak, err)
+                tel.event("supervisor_give_up",
+                          incarnations=incarnation + 1,
+                          streak=streak, outcome=CRASH,
+                          scope="serving", error=err)
+                if incident_dir:
+                    write_incident_bundle(
+                        incident_dir,
+                        reason=("serving crash-loop: no finished "
+                                f"request in the last {streak} "
+                                f"attempt(s); last error {err}"),
+                        kind="give_up",
+                        events_tail=tel.current().tail(),
+                        extra={"incarnations": incarnation + 1,
+                               "streak": streak, "scope": "serving"},
+                        serving=snap)
+                return {"engine": engine, "result": None,
+                        "incarnations": incarnation + 1,
+                        "restarts": incarnation, "gave_up": True,
+                        "crashes": crashes}
+            delay = policy.backoff_s(streak) if streak else 0.0
+            tel.event("restart", incarnation=incarnation,
+                      outcome=CRASH, scope="serving",
+                      advanced=advanced, backoff_s=round(delay, 3),
+                      budget=budget)
+            if delay > 0:
+                sleep(delay)
+            engine = make_engine()
+            engine.import_emission_state(emission)
+            if export["adoptable"]:
+                try:
+                    engine.adopt_batch(export["adoptable"])
+                except (RuntimeError, ValueError) as e:
+                    # The successor couldn't place the salvaged KV
+                    # (pool shape changed, capacity): restart those
+                    # from the prompt — correctness is untouched, the
+                    # high-water marks still dedup the streams.
+                    logger.warning("KV re-adoption refused (%s); "
+                                   "resubmitting from prompts", e)
+                    for req, _toks, _k, _v in export["adoptable"]:
+                        engine.submit(req)
+            for req in export["requests"]:
+                engine.submit(req)
+            for req in queued:
+                engine.submit(req)
+            incarnation += 1

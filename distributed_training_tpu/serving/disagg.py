@@ -253,6 +253,14 @@ class WeightStore:
                 "serving weights under a layout that does not match "
                 "their provenance")
 
+    @property
+    def provenance(self) -> dict | None:
+        """The artifact's plan provenance stamp ``{"name",
+        "fingerprint"}`` (None on legacy artifacts) — the baseline
+        ``Engine.swap_weights`` gates every live publish against."""
+        prov = (self.meta or {}).get("sharding_plan")
+        return dict(prov) if prov else None
+
     def params_for(self, mesh, plan):
         """The host weights laid out under ``plan`` on ``mesh``."""
         import jax.numpy as jnp

@@ -134,8 +134,14 @@ class EngineConfig:
     kv_axis: str = "tp"           # pool kv-head shard axis
     dp_axis: str = "dp"           # slot-table / pool batch shard axis
     paged_impl: str = "auto"      # ops/paged_attention dispatch
+    swap_staleness_tokens: int = -1  # hot-swap bound (-1 = unbounded)
 
     def __post_init__(self):
+        if self.swap_staleness_tokens < -1:
+            raise ValueError(
+                "swap_staleness_tokens must be >= -1 (-1 disables "
+                "the bound; 0 resubmits every in-flight request with "
+                "emitted tokens at swap time)")
         if self.policy not in ("prefill", "decode"):
             raise ValueError(
                 f"unknown scheduling policy '{self.policy}' "
@@ -208,6 +214,10 @@ class _Seq:
     trace: list = field(default_factory=list)  # lifecycle spans
     queue_wait_s: float | None = None  # arrival -> admission
     prefix_hit: int = 0           # prompt tokens served from cache
+    # Per-token weight-version tags, run-length encoded as
+    # ``[version, count]`` pairs in emission order — a sequence that
+    # straddles a hot-swap shows both versions; most show one.
+    versions: list = field(default_factory=list)
 
     def span(self, ev: str, t: float, **fields) -> None:
         """Append a lifecycle span. ``t`` is an absolute monotonic
@@ -640,7 +650,8 @@ class Engine:
     """
 
     def __init__(self, model, params, cfg: EngineConfig,
-                 mesh=None):
+                 mesh=None, weights_version: str = "v0",
+                 weights_provenance: dict | None = None):
         import jax
 
         if getattr(model.cfg, "moe_num_experts", 0) > 0:
@@ -655,6 +666,13 @@ class Engine:
         self.cfg = cfg
         self.mesh = mesh
         self.params = params
+        # Live-swap state (``swap_weights`` is the ONLY other place
+        # allowed to rebind ``self.params`` — pitfalls rule DTT011).
+        self.weights_version = weights_version
+        self.weights_provenance = (dict(weights_provenance)
+                                   if weights_provenance else None)
+        self.swap_stats = {"installed": 0, "refused": 0,
+                           "stale_preempted": 0}
         self.dp_groups = _dp_extent(mesh, cfg.dp_axis)
         if cfg.max_batch % self.dp_groups:
             raise ValueError(
@@ -725,6 +743,23 @@ class Engine:
         self._step_counter = 0
         self._base_rng = jax.random.PRNGKey(cfg.seed)
         self._token_listeners: dict[str, object] = {}
+        # Exactly-once stream state: per-request emitted-token
+        # high-water mark. SURVIVES preemption (unlike the listener
+        # registry) so a resubmitted request's regenerated prefix —
+        # greedy decode makes it token-identical — is never delivered
+        # twice; popped only at completion. ``finished_total`` is the
+        # monotone progress counter the serving supervisor's restart
+        # budget refunds against.
+        self._emit_hwm: dict[str, int] = {}
+        self.finished_total = 0
+        # Drain / fault-injection state: ``draining`` gates admission
+        # only (in-flight work keeps stepping); ``launch_count`` is
+        # the serving analogue of the global step — one per non-idle
+        # step — that ``resilience/faults.py`` serving kinds key on
+        # via the ``faults`` injector slot (None = no injection).
+        self.draining = False
+        self.launch_count = 0
+        self.faults = None
         self._build_programs()
         # Greedy decode never reads the rng operand — fold_in/
         # key_data are ~5 device dispatches PER STEP, and on the CPU
@@ -921,6 +956,7 @@ class Engine:
               e2e_s=(now - arrival) if arrival is not None else None,
               prefix_hit_tokens=seq.prefix_hit,
               tokens_discarded=tokens_discarded,
+              weights_versions=[list(p) for p in seq.versions],
               spans=list(seq.trace))
 
     def add_token_listener(self, req_id: str, fn) -> None:
@@ -934,15 +970,31 @@ class Engine:
         self._token_listeners.pop(req_id, None)
 
     def _emit_token(self, seq: _Seq, token: int) -> None:
+        # Tag EVERY emitted token with the live weight version
+        # (run-length on the sequence — the trace/debug surfaces
+        # decode it), listener or not.
+        if not seq.versions or \
+                seq.versions[-1][0] != self.weights_version:
+            seq.versions.append([self.weights_version, 0])
+        seq.versions[-1][1] += 1
+        # Exactly-once gate: this token's index vs the request's
+        # high-water mark. A replayed prefix (preempt-resubmit or
+        # crash re-adoption regenerates tokens already emitted —
+        # greedy-identical values) advances the slot state but is NOT
+        # re-delivered.
+        idx = len(seq.generated) - 1
+        hwm = self._emit_hwm.get(seq.req.id, 0)
+        fresh = idx >= hwm
+        if fresh:
+            self._emit_hwm[seq.req.id] = idx + 1
         fn = self._token_listeners.get(seq.req.id)
-        if fn is None:
-            return
-        try:
-            fn(int(token), seq.done)
-        except Exception:
-            logger.exception("token listener for %r failed; "
-                             "dropping it", seq.req.id)
-            self._token_listeners.pop(seq.req.id, None)
+        if fn is not None and fresh:
+            try:
+                fn(int(token), seq.done)
+            except Exception:
+                logger.exception("token listener for %r failed; "
+                                 "dropping it", seq.req.id)
+                self._token_listeners.pop(seq.req.id, None)
         if seq.done:
             self._token_listeners.pop(seq.req.id, None)
 
@@ -1003,7 +1055,7 @@ class Engine:
         turn is resident resumes in ITS group (pages cannot cross a
         pool shard) or waits for a slot there. None = backpressure —
         the request stays queued."""
-        if not self.queue:
+        if self.draining or not self.queue:
             return None
         req = self.queue[0]
         plen = int(req.prompt.shape[0])
@@ -1224,7 +1276,8 @@ class Engine:
         """
         t0 = time.monotonic()
         pending = self._prefill_candidates()
-        can_admit = (self.queue and self._free_slot() is not None)
+        can_admit = (not self.draining and self.queue
+                     and self._free_slot() is not None)
         want_prefill = bool(pending or can_admit)
         decodable = self._decode_candidates()
         if self.cfg.policy == "prefill":
@@ -1244,7 +1297,8 @@ class Engine:
                 # Admit everything slots+pages allow BEFORE the
                 # launch — one admission per step would starve the
                 # lane table the batched program pays for.
-                while self.queue and self._admit() is not None:
+                while not self.draining and self.queue \
+                        and self._admit() is not None:
                     pass
                 tokens_out = self._run_prefill_batch(
                     self._prefill_candidates())
@@ -1320,7 +1374,35 @@ class Engine:
                     self._last_prefill_lanes
         event("serving", **rec)
         self._step_counter += 1
+        if kind != "idle":
+            self.launch_count += 1
+            if self.faults is not None:
+                self._run_faults()
         return rec
+
+    def _run_faults(self) -> None:
+        """Serving fault hook, fired AFTER the step record is emitted
+        (the fault ledger write happens inside the injector BEFORE
+        any action — crash/restart cannot re-fire a fault). The
+        injector sleeps ``slow_decode`` itself; the engine performs
+        the actions that need its state: ``client_disconnect`` drops
+        one live stream listener (the high-water mark keeps
+        advancing, so the severed stream never resumes mid-request
+        with duplicates), and ``engine_crash`` raises out of
+        ``step()`` exactly like a real engine-thread fault."""
+        from distributed_training_tpu.resilience.faults import (
+            InjectedCrash)
+
+        fired = self.faults.on_launch(self.launch_count)
+        if "client_disconnect" in fired and self._token_listeners:
+            rid = next(iter(self._token_listeners))
+            self._token_listeners.pop(rid, None)
+            logger.warning("injected client_disconnect: dropped "
+                           "stream listener %r", rid)
+        if "engine_crash" in fired:
+            raise InjectedCrash(
+                f"injected engine_crash at launch "
+                f"{self.launch_count}")
 
     def _fetch_host(self, *arrays) -> tuple:
         """THE designated device->host sync point of the serving hot
@@ -1856,8 +1938,11 @@ class Engine:
             "latency_s": now - arrival,
             "token_gaps_s": gaps,
             "group": self.group_of_slot(seq.slot),
+            "weights_versions": [list(p) for p in seq.versions],
         }
         self.completed.append(rec)
+        self.finished_total += 1
+        self._emit_hwm.pop(seq.req.id, None)
         event("serving_request",
               **{k: rec[k] for k in ("id", "tenant",
                                      "prompt_tokens", "new_tokens",
@@ -1909,21 +1994,35 @@ class Engine:
         page import (serving/disagg.py ``import_kv_batch`` — a single
         scatter per pool instead of one device round-trip per
         request; the continuous-handoff rate path). ``items`` is a
-        list of ``(req, first_token, k_dense, v_dense)``. Raises
-        before touching the pool when any request cannot get a
-        slot+pages — the caller holds the batch and retries once
-        decode frees capacity."""
+        list of ``(req, tokens, k_dense, v_dense)`` where ``tokens``
+        is either the single first sampled token (the disaggregation
+        handoff) or the FULL generated history so far (the crash-
+        recovery re-adoption, ``export_in_flight``) — the dense KV
+        must cover ``prompt_len + len(tokens) - 1`` positions, the
+        decode invariant (the newest token's KV is written by its own
+        decode launch). Raises before touching the pool when any
+        request cannot get a slot+pages — the caller holds the batch
+        and retries once decode frees capacity."""
         from distributed_training_tpu.serving.disagg import (
             import_kv_batch)
 
         now = time.monotonic()
         staged = []
         try:
-            for req, first_token, k_dense, v_dense in items:
+            for req, toks, k_dense, v_dense in items:
+                tokens = ([int(toks)]
+                          if isinstance(toks, (int, np.integer))
+                          else [int(t) for t in toks])
+                if not tokens:
+                    raise ValueError(
+                        f"adopt of {req.id!r} carries no tokens — a "
+                        "never-decoded sequence resubmits as a fresh "
+                        "request instead")
                 if req.arrival is None:
                     req.arrival = now
                 self._validate(req)
-                picked = self._pick_group(req.prompt.shape[0])
+                need = req.prompt.shape[0] + len(tokens) - 1
+                picked = self._pick_group(need)
                 if picked is None:
                     raise RuntimeError(
                         f"no free slot/pages to adopt {req.id!r} "
@@ -1934,7 +2033,7 @@ class Engine:
                            prefilled=req.prompt.shape[0])
                 self._mark_admitted(seq, "adopted", group=group)
                 self.slots[slot] = seq
-                staged.append((seq, first_token, k_dense, v_dense))
+                staged.append((seq, tokens, k_dense, v_dense))
             import_kv_batch(self.cache,
                             [(s.req.id, k, v)
                              for s, _t, k, v in staged])
@@ -1949,15 +2048,15 @@ class Engine:
                 self.slots[s.slot] = None
             raise
         now = time.monotonic()
-        for seq, first_token, _k, _v in staged:
+        for seq, tokens, _k, _v in staged:
             seq.first_token_t = now
-            seq.token_times.append(now)
-            seq.generated.append(int(first_token))
-            if self.cfg.eos_id >= 0 and \
-                    int(first_token) == self.cfg.eos_id:
-                seq.eos = True
-            self._emit_token(seq, int(first_token))
-            self._register(seq)
+            for tok in tokens:
+                seq.token_times.append(now)
+                seq.generated.append(tok)
+                if self.cfg.eos_id >= 0 and tok == self.cfg.eos_id:
+                    seq.eos = True
+                self._emit_token(seq, tok)
+                self._register(seq)
             self._maybe_finish(seq)
 
     def preempt(self) -> list[Request]:
@@ -1998,6 +2097,249 @@ class Engine:
             self._token_listeners.pop(req.id, None)
         event("serving_preempt", lost=len(lost))
         return lost
+
+    # -- resilience: live weight swap, drain, crash recovery ----------------
+
+    def _replay_request(self, seq: _Seq) -> Request:
+        """A fresh Request preserving the ORIGINAL identity (id,
+        arrival, session, tenant) — resubmission/re-adoption keeps
+        the queue-wait accounting and the exactly-once stream keyed
+        to the same request."""
+        return Request(id=seq.req.id, prompt=seq.req.prompt,
+                       max_new_tokens=seq.req.max_new_tokens,
+                       arrival=seq.req.arrival,
+                       session=seq.req.session,
+                       tenant=seq.req.tenant)
+
+    def _preempt_seq(self, seq: _Seq) -> None:
+        """Preempt ONE in-flight sequence back to the head of the
+        queue (the staleness-bound path): pages freed, slot vacated,
+        trace closed honestly. Unlike ``preempt()`` the stream
+        listener and the emitted-token high-water mark are KEPT —
+        greedy decode regenerates a token-identical prefix, and the
+        high-water mark suppresses its re-delivery, so the client
+        stream continues exactly once."""
+        now = time.monotonic()
+        self._emit_trace(seq, "preempted", now,
+                         tokens_discarded=len(seq.generated))
+        self.cache.free(seq.req.id)
+        self.slots[seq.slot] = None
+        self.queue.appendleft(self._replay_request(seq))
+
+    def swap_weights(self, params, version: str,
+                     provenance: dict | None = None) -> int:
+        """Install a new weight set into the RUNNING engine between
+        launches — the live hot-swap (ROADMAP item 1's transfer
+        primitive). All-or-nothing: every gate below runs BEFORE the
+        first byte is installed, and a refusal leaves the engine
+        serving the incumbent version untouched.
+
+        Gates, in order: (1) injected ``swap_corrupt`` (a torn
+        publish whose artifact no longer verifies), (2) plan
+        provenance — the publish must carry the SAME plan name +
+        fingerprint the engine's weights were laid out under (the
+        WeightStore discipline: new weights under a silently-
+        regenerated plan are refused), (3) pytree structure, (4)
+        per-leaf shape/dtype, (5) placement — each leaf is
+        ``device_put`` onto the incumbent leaf's sharding, so the
+        installed tree is layout-identical and every existing jit
+        entry is reused (ZERO recompiles; the programs take params as
+        a call argument, never close over them).
+
+        After install, in-flight sequences keep decoding — their
+        remaining tokens come from the new version and every emitted
+        token is version-tagged. With ``cfg.swap_staleness_tokens``
+        >= 0, any sequence that has already emitted MORE than that
+        many old-version tokens is preempted-and-resubmitted instead
+        (regenerating token-identically under the new version, the
+        high-water mark deduplicating its stream); the contract: a
+        completed request carries at most ``swap_staleness_tokens``
+        tokens from a superseded version. Returns the number of
+        sequences preempted for staleness."""
+        import jax
+
+        from distributed_training_tpu.serving.disagg import (
+            ProvenanceError)
+
+        def _refuse(exc: Exception):
+            self.swap_stats["refused"] += 1
+            event("serving_swap", outcome="refused",
+                  version=version, engine_version=self.weights_version,
+                  reason=str(exc))
+            logger.warning("weight swap to %r REFUSED: %s", version,
+                           exc)
+            raise exc
+
+        if self.faults is not None and \
+                self.faults.on_swap(self.launch_count):
+            _refuse(ProvenanceError(
+                f"swap to {version!r}: injected swap_corrupt — "
+                "published artifact failed verification"))
+        if self.weights_provenance is not None:
+            if provenance is None:
+                _refuse(ProvenanceError(
+                    f"swap to {version!r}: engine weights carry plan "
+                    f"provenance ({self.weights_provenance.get('name')})"
+                    " but the publish carries none"))
+            for key in ("name", "fingerprint"):
+                if provenance.get(key) != \
+                        self.weights_provenance.get(key):
+                    _refuse(ProvenanceError(
+                        f"swap to {version!r}: plan {key} mismatch — "
+                        f"engine {self.weights_provenance.get(key)!r}"
+                        f" vs publish {provenance.get(key)!r}"))
+        elif provenance is None:
+            logger.warning(
+                "weight swap to %r: no provenance on either side "
+                "(legacy artifact) — accepting on shape/dtype/"
+                "placement gates only", version)
+        old_leaves, old_def = jax.tree.flatten(self.params)
+        new_leaves, new_def = jax.tree.flatten(params)
+        if old_def != new_def:
+            _refuse(ValueError(
+                f"swap to {version!r}: params tree structure "
+                f"differs from the serving tree"))
+        bad = [i for i, (o, n) in
+               enumerate(zip(old_leaves, new_leaves))
+               if getattr(o, "shape", None) != getattr(n, "shape",
+                                                       None)
+               or getattr(o, "dtype", None) != getattr(n, "dtype",
+                                                       None)]
+        if bad:
+            _refuse(ValueError(
+                f"swap to {version!r}: {len(bad)} leaf(s) differ in "
+                f"shape/dtype (first at flat index {bad[0]})"))
+        # Placement: each leaf lands on the incumbent leaf's sharding
+        # so every existing jit entry is reused. Leaves already laid
+        # out identically (same sharding AND same device-commitment —
+        # commitment is part of the jit cache key, so a gratuitous
+        # device_put on an uncommitted tree would retrace) pass
+        # through untouched.
+        def _place(o, n):
+            if not hasattr(o, "sharding"):
+                return n
+            if getattr(n, "sharding", None) == o.sharding and \
+                    getattr(n, "committed", None) == \
+                    getattr(o, "committed", None):
+                return n
+            return jax.device_put(n, o.sharding)
+
+        placed = [_place(o, n)
+                  for o, n in zip(old_leaves, new_leaves)]
+        # Every gate passed: install. The ONE sanctioned rebinding of
+        # ``self.params`` outside __init__ (pitfalls rule DTT011).
+        self.params = jax.tree.unflatten(old_def, placed)
+        self.weights_version = version
+        if provenance is not None:
+            self.weights_provenance = dict(provenance)
+        self.swap_stats["installed"] += 1
+        bound = self.cfg.swap_staleness_tokens
+        stale = []
+        if bound >= 0:
+            stale = [s for s in self.slots
+                     if s is not None and len(s.generated) > bound]
+            for s in stale:
+                self._preempt_seq(s)
+            self.swap_stats["stale_preempted"] += len(stale)
+        event("serving_swap", outcome="installed", version=version,
+              stale_preempted=len(stale), in_flight=self.in_flight,
+              swaps_installed=self.swap_stats["installed"])
+        return len(stale)
+
+    def drain(self, deadline_s: float | None = None) -> dict:
+        """Graceful drain: stop admission, run in-flight work to
+        completion (or to ``deadline_s``), and report per-request
+        outcomes. Queued-but-never-admitted requests stay queued and
+        are listed as ``requeued`` (a successor engine submits them
+        verbatim); at the deadline, still-in-flight sequences are
+        persisted host-side via ``export_in_flight`` and returned
+        under ``persisted`` for re-adoption. Retained sessions
+        survive by construction — they live in the cache's refcounted
+        session table, not in slots. The engine stays ``draining``
+        afterwards (flip the flag to reopen admission)."""
+        self.draining = True
+        t0 = time.monotonic()
+        n0 = len(self.completed)
+        steps = 0
+        while self.in_flight and \
+                (deadline_s is None
+                 or time.monotonic() - t0 < deadline_s):
+            self.step()
+            steps += 1
+            if steps > 200_000:
+                raise RuntimeError(
+                    "drain not converging after 200k steps "
+                    f"(in_flight={self.in_flight})")
+        persisted = self.export_in_flight() if self.in_flight \
+            else {"adoptable": [], "requests": []}
+        report = {
+            "finished": [r["id"] for r in self.completed[n0:]],
+            "persisted": ([it[0].id for it in persisted["adoptable"]]
+                          + [r.id for r in persisted["requests"]]),
+            "requeued": [r.id for r in self.queue],
+            "steps": steps,
+            "duration_s": time.monotonic() - t0,
+            "export": persisted,
+        }
+        event("serving_drain", deadline_s=deadline_s,
+              finished=len(report["finished"]),
+              persisted=len(report["persisted"]),
+              requeued=len(report["requeued"]),
+              steps=steps, duration_s=report["duration_s"])
+        return report
+
+    def export_in_flight(self) -> dict:
+        """Persist every in-flight sequence host-side and vacate its
+        device state (the crash-salvage / drain-deadline path).
+        Sequences that have decoded at least one token export their
+        EXACT dense KV (one batched ``export_kv_batch`` fetch) plus
+        generated history — ``adopt_batch`` items for a successor
+        engine, nothing recomputed but the newest token's KV write
+        (the decode invariant: ``prompt + generated - 1`` positions
+        are resident). Never-decoded sequences (mid-prefill, or
+        zero-prefill admissions awaiting their first launch) come
+        back as fresh ``Request``s — nothing was emitted, so restart
+        costs only their prefill. Traces close as ``preempted`` with
+        ``tokens_discarded=0`` for the persisted group (their tokens
+        survive). Listeners and high-water marks are NOT touched —
+        ``export_emission_state`` carries those."""
+        from distributed_training_tpu.serving.disagg import (
+            export_kv_batch)
+
+        now = time.monotonic()
+        seqs = [s for s in self.slots if s is not None]
+        adoptable = [s for s in seqs
+                     if s.prefill_done and s.generated]
+        adopt_ids = {id(s) for s in adoptable}
+        fresh = [s for s in seqs if id(s) not in adopt_ids]
+        ks, vs = (export_kv_batch(self.cache,
+                                  [s.req.id for s in adoptable])
+                  if adoptable else ([], []))
+        items = [(self._replay_request(s), list(s.generated), k, v)
+                 for s, k, v in zip(adoptable, ks, vs)]
+        requests = [self._replay_request(s) for s in fresh]
+        for s in seqs:
+            discarded = 0 if id(s) in adopt_ids \
+                else len(s.generated)
+            self._emit_trace(s, "preempted", now,
+                             tokens_discarded=discarded)
+            self.cache.free(s.req.id)
+            self.slots[s.slot] = None
+        return {"adoptable": items, "requests": requests}
+
+    def export_emission_state(self) -> dict:
+        """Host-side exactly-once stream state for an IN-PROCESS
+        successor engine: the per-request emitted-token high-water
+        marks plus the live token listeners (callables — same-process
+        transfer only, the serving supervisor's restart path)."""
+        return {"hwm": dict(self._emit_hwm),
+                "listeners": dict(self._token_listeners)}
+
+    def import_emission_state(self, state: dict | None) -> None:
+        if not state:
+            return
+        self._emit_hwm.update(state.get("hwm", {}))
+        self._token_listeners.update(state.get("listeners", {}))
 
 
 # ---------------------------------------------------------------------------

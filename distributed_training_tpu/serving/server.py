@@ -76,12 +76,18 @@ def debug_requests_snapshot(engine) -> dict:
                 "generated": len(s.generated),
                 "pages_held":
                     engine.cache.pages_of(s.req.id),
-                "session": s.req.session})
+                "session": s.req.session,
+                "weights_versions": [list(p) for p in s.versions]})
         except KeyError:
             continue  # freed between reads
     return {
         "in_flight": len(reqs),
         "queue_depth": len(engine.queue),
+        "draining": bool(getattr(engine, "draining", False)),
+        "weights": {
+            "version": engine.weights_version,
+            "provenance": engine.weights_provenance,
+            "swaps": dict(engine.swap_stats)},
         "requests": reqs}
 
 
@@ -89,7 +95,10 @@ class ServingServer:
     """HTTP front + engine thread over a built Engine."""
 
     def __init__(self, engine, port: int = 0,
-                 metrics_port: int | None = None, telemetry=None):
+                 metrics_port: int | None = None, telemetry=None,
+                 max_queue_depth: int = 0,
+                 retry_after_s: float = 1.0,
+                 incident_dir: str | None = None):
         self.engine = engine
         self._requested_port = port
         self.port: int | None = None
@@ -104,6 +113,24 @@ class ServingServer:
         self._http_thread = None
         self._next_id = 0
         self._telemetry = telemetry
+        # Admission control + resilience knobs: with
+        # ``max_queue_depth`` > 0, POST /generate sheds load (503 +
+        # Retry-After) once queue+mailbox reach it — a bounded queue
+        # beats clients silently timing out behind an unbounded one.
+        # ``incident_dir`` set → an engine-thread exception leaves a
+        # flight-recorder bundle there (kind ``engine_crash``).
+        self.max_queue_depth = int(max_queue_depth)
+        self.retry_after_s = float(retry_after_s)
+        self.incident_dir = incident_dir
+        # The engine thread's cause of death, when it died to an
+        # exception (healthz reports "unhealthy"; new work is shed).
+        self.engine_error: str | None = None
+        self.leaked_threads = 0
+        # Control commands (drain / weight swap) execute BETWEEN
+        # steps ON the engine thread — the engine stays
+        # single-threaded; public drain()/swap_weights() enqueue here
+        # and wait.
+        self._control: list = []
         # A MetricsServer ALWAYS backs GET /metrics on the serving
         # port (its renderer + observer, no second socket) so a
         # serving-only deployment needs no coordinator metrics port;
@@ -120,13 +147,152 @@ class ServingServer:
         incident recorder's ``serving_snapshot`` hook."""
         return debug_requests_snapshot(self.engine)
 
+    @property
+    def draining(self) -> bool:
+        return bool(getattr(self.engine, "draining", False))
+
+    def _control_call(self, cmd: str, args,
+                      timeout: float = 300.0):
+        """Run a drain/swap command ON the engine thread (started
+        server) or inline (engine thread not running — the in-process
+        test path); either way the engine is only ever touched from
+        one thread at a time."""
+        t = self._engine_thread
+        if t is None or not t.is_alive():
+            done = threading.Event()
+            slot: dict = {}
+            self._control.append((cmd, args, done, slot))
+            self._run_control(self.engine)
+        else:
+            done = threading.Event()
+            slot = {}
+            with self._lock:
+                self._control.append((cmd, args, done, slot))
+            if not done.wait(timeout):
+                raise TimeoutError(f"{cmd} command timed out after "
+                                   f"{timeout}s")
+        if "error" in slot:
+            raise slot["error"]
+        return slot.get("result")
+
+    def swap_weights(self, params, version: str,
+                     provenance: dict | None = None,
+                     timeout: float = 300.0):
+        """Live weight hot-swap through the engine thread
+        (``Engine.swap_weights`` — all gates, zero recompiles).
+        Raises the engine's refusal verbatim; the incumbent weights
+        keep serving on any failure."""
+        return self._control_call("swap", (params, version,
+                                           provenance), timeout)
+
+    def drain(self, deadline_s: float | None = None,
+              timeout: float = 300.0) -> dict:
+        """Graceful drain through the engine thread: admission stops
+        (POST /generate starts 503ing with Retry-After, /healthz
+        reports "draining"), in-flight work finishes (or persists at
+        the deadline), and the per-request outcome report returns.
+        ``resume_admission()`` reopens the front door."""
+        return self._control_call("drain", deadline_s,
+                                  max(timeout, (deadline_s or 0) * 2))
+
+    def resume_admission(self, timeout: float = 60.0) -> None:
+        self._control_call("undrain", None, timeout)
+
     # -- engine thread -----------------------------------------------------
 
     def _engine_loop(self) -> None:
         from distributed_training_tpu.serving.engine import Request
 
         eng = self.engine
+        try:
+            self._engine_loop_inner(eng, Request)
+        except Exception as e:  # noqa: BLE001 — the engine thread's
+            # last act: record WHY it died (bundle + event + error
+            # replies) instead of dying silently with every in-flight
+            # client blocked until timeout.
+            self._on_engine_crash(e)
+
+    def _on_engine_crash(self, exc: Exception) -> None:
+        """Engine-thread postmortem: mark unhealthy, fail every
+        waiting client, emit ``serving_engine_crash``, and (with
+        ``incident_dir``) leave a flight-recorder bundle carrying the
+        ``/debug/requests`` snapshot and the last weight-swap
+        provenance — the evidence ``--doctor`` classifies as
+        ``serving_engine_crash``."""
+        from distributed_training_tpu import telemetry as tel
+
+        err = f"{type(exc).__name__}: {exc}"
+        self.engine_error = err
+        logger.exception("serving engine thread died: %s", err)
+        eng = self.engine
+        snap = None
+        try:
+            snap = debug_requests_snapshot(eng)
+        except Exception:  # noqa: BLE001 — evidence is best-effort;
+            # the postmortem must survive a half-broken engine.
+            logger.warning("debug snapshot failed during crash "
+                           "postmortem", exc_info=True)
+        # Event BEFORE the bundle so its events_tail carries the
+        # record the doctor keys on.
+        tel.event("serving_engine_crash", error=err,
+                  launches=getattr(eng, "launch_count", None),
+                  weights_version=getattr(eng, "weights_version",
+                                          None),
+                  in_flight=eng.in_flight,
+                  queue_depth=len(eng.queue))
+        if self.incident_dir:
+            from distributed_training_tpu.telemetry.incident import (
+                write_incident_bundle)
+            write_incident_bundle(
+                self.incident_dir, reason=err, kind="engine_crash",
+                events_tail=tel.current().tail(),
+                extra={"launch_count": getattr(eng, "launch_count",
+                                               None),
+                       "weights_version": getattr(
+                           eng, "weights_version", None),
+                       "weights_provenance": getattr(
+                           eng, "weights_provenance", None),
+                       "swap_stats": dict(getattr(eng, "swap_stats",
+                                                  {}))},
+                serving=snap)
+        with self._lock:
+            events, self._events = self._events, {}
+            streams, self._streams = self._streams, {}
+            for rid, ev in events.items():
+                self._done[rid] = {"id": rid,
+                                   "error": f"engine crashed: {err}"}
+                ev.set()
+        for rid, sq in streams.items():
+            sq.put(("done", {"id": rid,
+                             "error": f"engine crashed: {err}"}))
+
+    def _run_control(self, eng) -> None:
+        """Execute queued drain/swap commands on the engine thread.
+        Results (or the refusal exception) hand back through each
+        command's slot; the caller re-raises in its own thread."""
+        with self._lock:
+            cmds, self._control = self._control, []
+        for cmd, args, done, slot in cmds:
+            try:
+                if cmd == "swap":
+                    params, version, provenance = args
+                    slot["result"] = eng.swap_weights(
+                        params, version, provenance)
+                elif cmd == "drain":
+                    slot["result"] = eng.drain(args)
+                elif cmd == "undrain":
+                    eng.draining = False
+                    slot["result"] = True
+            except Exception as e:  # noqa: BLE001 — a REFUSED swap
+                # must reach its caller, never kill the engine
+                # thread (the engine still serves the incumbent).
+                slot["error"] = e
+            finally:
+                done.set()
+
+    def _engine_loop_inner(self, eng, Request) -> None:
         while not self._stop.is_set():
+            self._run_control(eng)
             with self._lock:
                 incoming, self._mailbox = self._mailbox, []
             for rid, prompt, n, arrival, session, tenant \
@@ -161,21 +327,29 @@ class ServingServer:
                     if sq is not None:
                         sq.put(("done", {"id": rid,
                                          "error": str(e)}))
+            # Dispatch BEFORE the idle check too: a drain command
+            # finishes requests inside _run_control, and their
+            # waiting clients must not hang on an idle engine.
+            self._dispatch_completed(eng)
             if eng.idle:
                 time.sleep(0.002)
                 continue
             eng.step()
-            if eng.completed:
-                with self._lock:
-                    for rec in eng.completed:
-                        ev = self._events.pop(rec["id"], None)
-                        if ev is not None:
-                            self._done[rec["id"]] = rec
-                            ev.set()
-                        sq = self._streams.pop(rec["id"], None)
-                        if sq is not None:
-                            sq.put(("done", rec))
-                eng.completed.clear()
+            self._dispatch_completed(eng)
+
+    def _dispatch_completed(self, eng) -> None:
+        if not eng.completed:
+            return
+        with self._lock:
+            for rec in eng.completed:
+                ev = self._events.pop(rec["id"], None)
+                if ev is not None:
+                    self._done[rec["id"]] = rec
+                    ev.set()
+                sq = self._streams.pop(rec["id"], None)
+                if sq is not None:
+                    sq.put(("done", rec))
+        eng.completed.clear()
 
     def generate(self, prompt: np.ndarray, max_new_tokens: int,
                  timeout: float = 120.0,
@@ -326,11 +500,14 @@ class ServingServer:
             # Content-Length, so keep-alive semantics stay valid.
             protocol_version = "HTTP/1.1"
 
-            def _reply(self, code: int, payload: dict) -> None:
+            def _reply(self, code: int, payload: dict,
+                       headers: tuple = ()) -> None:
                 body = (json.dumps(payload) + "\n").encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
+                for k, v in headers:
+                    self.send_header(k, v)
                 # One request per connection: clients here are
                 # one-shot, and a dangling keep-alive socket at
                 # server stop() surfaces as handler-thread noise.
@@ -338,6 +515,28 @@ class ServingServer:
                 self.close_connection = True
                 self.end_headers()
                 self.wfile.write(body)
+
+            def _shed(self) -> dict | None:
+                """Load-shedding gate for POST /generate: 503 +
+                Retry-After while draining, after an engine crash,
+                or past the configured queue depth — a bounded
+                refusal beats queuing until the client times out."""
+                eng = server.engine
+                if server.engine_error is not None:
+                    return {"error": "engine crashed: "
+                                     + server.engine_error}
+                if server.draining:
+                    return {"error": "draining: not admitting new "
+                                     "requests"}
+                if server.max_queue_depth > 0:
+                    with server._lock:
+                        depth = (len(eng.queue)
+                                 + len(server._mailbox))
+                    if depth >= server.max_queue_depth:
+                        return {"error": "queue full "
+                                         f"(depth {depth} >= "
+                                         f"{server.max_queue_depth})"}
+                return None
 
             def _chunk(self, data: bytes) -> None:
                 self.wfile.write(f"{len(data):X}\r\n".encode()
@@ -392,6 +591,12 @@ class ServingServer:
                 if self.path.split("?")[0] != "/generate":
                     self._reply(404, {"error": "try POST /generate"})
                     return
+                shed = self._shed()
+                if shed is not None:
+                    self._reply(503, shed, headers=(
+                        ("Retry-After",
+                         str(max(1, int(server.retry_after_s)))),))
+                    return
                 try:
                     n = int(self.headers.get("Content-Length", 0))
                     body = json.loads(self.rfile.read(n) or b"{}")
@@ -412,10 +617,24 @@ class ServingServer:
                 path = self.path.split("?")[0]
                 eng = server.engine
                 if path == "/healthz":
-                    self._reply(200, {
-                        "status": "ok",
+                    # Tri-state: "unhealthy" (503) when the engine
+                    # thread died, "draining" (200 — the pod is
+                    # healthy, just not admitting) during a drain,
+                    # else "ok".
+                    alive = (server._engine_thread is not None
+                             and server._engine_thread.is_alive())
+                    if server.engine_error is not None or not alive:
+                        status, code = "unhealthy", 503
+                    elif server.draining:
+                        status, code = "draining", 200
+                    else:
+                        status, code = "ok", 200
+                    self._reply(code, {
+                        "status": status,
+                        "error": server.engine_error,
                         "in_flight": eng.in_flight,
                         "queue_depth": len(eng.queue),
+                        "weights_version": eng.weights_version,
                         **eng.cache.occupancy()})
                     return
                 if path == "/metrics":
@@ -474,6 +693,14 @@ class ServingServer:
         return self
 
     def stop(self) -> None:
+        """Stop the HTTP front + engine thread. Thread joins carry a
+        5 s timeout — a wedged engine step must not hang teardown —
+        but a straggler is COUNTED, not silently leaked: the
+        ``serving_stop`` telemetry event reports ``leaked_threads``
+        (0 after every clean stop, pinned by test) so a leak shows in
+        the stream instead of as mystery state in the next test."""
+        from distributed_training_tpu import telemetry as tel
+
         self._stop.set()
         if self._httpd is not None:
             self._httpd.shutdown()
@@ -481,9 +708,19 @@ class ServingServer:
             self._httpd = None
         if self.metrics is not None:
             self.metrics.stop()
+        leaked = []
         for t in (self._engine_thread, self._http_thread):
             if t is not None:
                 t.join(timeout=5)
+                if t.is_alive():
+                    leaked.append(t.name)
+        self.leaked_threads = len(leaked)
+        if leaked:
+            logger.warning("serving stop leaked %d thread(s): %s",
+                           len(leaked), ", ".join(leaked))
+        tel.event("serving_stop", leaked_threads=len(leaked),
+                  leaked=leaked,
+                  engine_error=self.engine_error)
         self._engine_thread = self._http_thread = None
 
 
@@ -515,13 +752,21 @@ def engine_config_from_yaml(plan, engine_block: dict):
     if "prefix_sharing" in engine_block \
             and engine_block["prefix_sharing"] is not None:
         over["prefix_sharing"] = bool(engine_block["prefix_sharing"])
+    # swap_staleness_tokens: 0 is a MEANINGFUL bound (resubmit every
+    # in-flight request at swap time), so it must dodge the 0-filter;
+    # -1/absent = unbounded.
+    if "swap_staleness_tokens" in engine_block \
+            and engine_block["swap_staleness_tokens"] is not None:
+        over["swap_staleness_tokens"] = int(
+            engine_block["swap_staleness_tokens"])
     return dataclasses.replace(base, **over)
 
 
 def build_server(artifact: str, plan_name: str, port: int = 0,
                  metrics_port: int | None = None,
                  telemetry=None,
-                 engine_block: dict | None = None) -> ServingServer:
+                 engine_block: dict | None = None,
+                 server_block: dict | None = None) -> ServingServer:
     """Artifact + committed plan → laid-out engine → server.
 
     The provenance gate lives in WeightStore: an artifact whose
@@ -543,11 +788,16 @@ def build_server(artifact: str, plan_name: str, port: int = 0,
     mesh = build_mesh(spec, jax.devices()[:spec.total])
     ecfg = engine_config_from_yaml(plan, engine_block or {})
     engine = Engine(model, store.params_for(mesh, plan), ecfg,
-                    mesh=mesh)
+                    mesh=mesh,
+                    weights_provenance=store.provenance)
     engine.warmup()
-    return ServingServer(engine, port=port,
-                         metrics_port=metrics_port,
-                         telemetry=telemetry)
+    sb = server_block or {}
+    return ServingServer(
+        engine, port=port, metrics_port=metrics_port,
+        telemetry=telemetry,
+        max_queue_depth=int(sb.get("max_queue_depth", 0) or 0),
+        retry_after_s=float(sb.get("retry_after_s", 1.0) or 1.0),
+        incident_dir=sb.get("incident_dir"))
 
 
 def main(argv=None) -> int:
@@ -598,9 +848,14 @@ def main(argv=None) -> int:
     # would stay empty (telemetry/events.py::_emit's fast path).
     tel = install(Telemetry(events_jsonl=os.path.join(
         "outputs", "serving", "events.jsonl")))
+    if not srv_conf.get("incident_dir"):
+        srv_conf = {**srv_conf,
+                    "incident_dir": os.path.join(
+                        "outputs", "serving", "incidents")}
     srv = build_server(args.artifact, plan_name, port=port,
                        metrics_port=metrics_port, telemetry=tel,
-                       engine_block=conf.get("engine") or {})
+                       engine_block=conf.get("engine") or {},
+                       server_block=srv_conf)
     if srv.start() is None:
         return 1
     print(f"serving on :{srv.port} (metrics :{metrics_port}); "

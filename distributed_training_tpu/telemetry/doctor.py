@@ -10,8 +10,9 @@ the same derived sections the summarizer computes (attribution,
 recovery, goodput, serving SLO ledger) together with the online
 detector's ``anomaly`` events and classifies the run into one of:
 
-    preemption_thrash | data_skip_storm | straggler |
-    serving_slo_breach | input_bound | exposed_comms | compute_bound
+    serving_engine_crash | preemption_thrash | data_skip_storm |
+    straggler | serving_slo_breach | input_bound | exposed_comms |
+    compute_bound
 
 Every verdict cites its evidence — the exact anomaly events (value vs
 baseline in MADs), the attribution fractions, the recovery table rows
@@ -32,9 +33,9 @@ import os
 SCHEMA = 1
 
 # Priority-ordered rule ids (first match wins the verdict).
-RULES = ("preemption_thrash", "data_skip_storm", "straggler",
-         "serving_slo_breach", "input_bound", "exposed_comms",
-         "compute_bound")
+RULES = ("serving_engine_crash", "preemption_thrash",
+         "data_skip_storm", "straggler", "serving_slo_breach",
+         "input_bound", "exposed_comms", "compute_bound")
 
 # Rule thresholds — module constants so tests pin them and the doc
 # table in docs/observability.md can cite them.
@@ -121,7 +122,8 @@ def load_target(path: str) -> dict:
 
 
 def diagnose(events: list[dict], anomaly: dict | None = None,
-             slo: tuple[float, float] | None = None) -> dict:
+             slo: tuple[float, float] | None = None,
+             incident: dict | None = None) -> dict:
     """Classify one event stream. Returns the report dict:
     ``verdict`` (a RULES member), ``findings`` (every matched rule,
     verdict first, each with its evidence lines), and the per-signal
@@ -155,6 +157,52 @@ def diagnose(events: list[dict], anomaly: dict | None = None,
     def add(rule: str, summary: str, evidence: list[str]) -> None:
         findings.append({"rule": rule, "summary": summary,
                          "evidence": evidence})
+
+    # 0. serving engine crash: the engine thread died (or the serving
+    # supervisor salvaged/gave up). Matched from the crash events the
+    # supervisor/server emit BEFORE writing their bundle — so a
+    # bundle's events_tail always carries the evidence — plus the
+    # bundle's own meta kind for stripped tails.
+    crashes = [e for e in events
+               if e.get("kind") == "serving_engine_crash"]
+    give_ups = [e for e in events
+                if e.get("kind") == "supervisor_give_up"
+                and e.get("scope") == "serving"]
+    bundle_says_crash = (incident or {}).get("kind") == "engine_crash"
+    if crashes or give_ups or bundle_says_crash:
+        ev = []
+        for c in crashes[-3:]:
+            ev.append(
+                f"  engine crash (incarnation "
+                f"{c.get('incarnation', '?')}, launch "
+                f"{c.get('launches', c.get('launch_count', '?'))}): "
+                f"{c.get('error', '?')}")
+            if c.get("weights_version") is not None:
+                ev.append(f"    weights_version "
+                          f"{c['weights_version']}, kv_salvaged "
+                          f"{c.get('kv_salvaged', 0)}, resubmitted "
+                          f"{c.get('resubmitted', 0)}")
+        crash_faults = [f for f in faults
+                        if f.startswith(("engine_crash",
+                                         "swap_corrupt"))]
+        if crash_faults:
+            ev.append(f"  injected fault(s): "
+                      f"{', '.join(crash_faults)}")
+        if give_ups:
+            ev.append(f"  supervisor GAVE UP after "
+                      f"{give_ups[-1].get('incarnations', '?')} "
+                      f"incarnation(s)")
+        if bundle_says_crash and not crashes:
+            ev.append("  bundle meta: kind=engine_crash (events "
+                      "tail carries no crash record — stripped "
+                      "tail)")
+        summary = (f"serving engine crashed "
+                   f"{max(len(crashes), 1)} time(s)")
+        if give_ups:
+            summary += "; supervisor gave up"
+        elif crashes:
+            summary += "; supervisor restarted it"
+        add("serving_engine_crash", summary, ev)
 
     # 1. preemption thrash: the run spent its life restarting.
     if rec and rec.get("restarts", 0) >= THRASH_RESTARTS:
@@ -293,7 +341,7 @@ def diagnose_path(path: str,
                   slo: tuple[float, float] | None = None) -> dict:
     target = load_target(path)
     report = diagnose(target["events"], anomaly=target["anomaly"],
-                      slo=slo)
+                      slo=slo, incident=target["meta"] or None)
     report["source"] = target["source"]
     report["path"] = path
     if target["meta"]:

@@ -59,7 +59,8 @@ BUNDLE_OPTIONAL_FILES = ("anomaly.json", "attribution.json",
                          "serving_requests.json")
 
 # Incident kinds the recorder emits / the doctor understands.
-KINDS = ("anomaly", "watchdog", "preemption", "give_up", "manual")
+KINDS = ("anomaly", "watchdog", "preemption", "give_up", "manual",
+         "engine_crash")
 
 AUTOPROFILE_LEDGER = "autoprofile_fired.json"
 

@@ -26,7 +26,11 @@ envelope's)::
      "e2e_s": float,                 # arrival -> finish/preempt
      "prefix_hit_tokens": int,       # prompt tokens served from cache
      "tokens_discarded": int,        # preempt only (0 on finish)
-     "spans": [{"ev": ..., "t": <seconds since arrival>, ...}, ...]}
+     "spans": [{"ev": ..., "t": <seconds since arrival>, ...}, ...],
+     "weights_versions": [[version, count], ...]}  # run-length list of
+                                     # the weight version each emitted
+                                     # token was produced under (the
+                                     # hot-swap audit trail)
 
 Span events (``SPAN_EVENTS``): ``queued`` (t=0 by construction, the
 request's arrival), ``admitted`` (group/slot/prefix_hit_tokens),
@@ -58,7 +62,7 @@ from __future__ import annotations
 TRACE_KEYS = (
     "id", "tenant", "outcome", "prompt_tokens", "new_tokens",
     "queue_wait_s", "ttft_s", "e2e_s", "prefix_hit_tokens",
-    "tokens_discarded", "spans",
+    "tokens_discarded", "spans", "weights_versions",
 )
 
 SPAN_EVENTS = (

@@ -74,11 +74,11 @@ def test_schema_pins():
     assert incident_mod.BUNDLE_OPTIONAL_FILES == (
         "anomaly.json", "attribution.json", "serving_requests.json")
     assert incident_mod.KINDS == ("anomaly", "watchdog", "preemption",
-                                  "give_up", "manual")
+                                  "give_up", "manual", "engine_crash")
     assert doctor_mod.RULES == (
-        "preemption_thrash", "data_skip_storm", "straggler",
-        "serving_slo_breach", "input_bound", "exposed_comms",
-        "compute_bound")
+        "serving_engine_crash", "preemption_thrash",
+        "data_skip_storm", "straggler", "serving_slo_breach",
+        "input_bound", "exposed_comms", "compute_bound")
 
 
 def test_median_mad():

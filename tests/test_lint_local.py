@@ -5,7 +5,7 @@ The CI lint job mirrors the reference's four gates
 but has never executed in this container — no runner, no tools, no
 network. tools/lint_local.py implements the mechanically-checkable
 subset (E501/W291/W293/W191/E711/E712/F401 + import-group order) plus
-the DTT00x pitfall-rule registry shared with
+the DTT0xx pitfall-rule registry shared with
 ``distributed_training_tpu/analysis/pitfalls.py``; this test makes
 `pytest tests/` red when a violation lands, which is the "gates have
 actually run on HEAD" evidence the CI job cannot provide here. The
@@ -79,8 +79,8 @@ def test_lint_and_analysis_share_one_rule_table():
     finally:
         sys.path.pop(0)
     assert {"DTT001", "DTT002", "DTT003", "DTT004", "DTT005",
-            "DTT006", "DTT007", "DTT008", "DTT009", "DTT010"} <= set(
-        lint_local.pitfalls.RULES)
+            "DTT006", "DTT007", "DTT008", "DTT009", "DTT010",
+            "DTT011"} <= set(lint_local.pitfalls.RULES)
 
 
 def test_lint_local_catches_violations(tmp_path):
@@ -228,3 +228,66 @@ def test_lint_local_serving_sync_rule():
     hits = [p for p in pf.check_file_rules(dis, repo=REPO, text=helper)
             if "DTT010" in p]
     assert len(hits) == 1 and ":5:" in hits[0], hits
+
+
+def test_lint_local_params_rebinding_rule():
+    """DTT011: `.params` rebinding in serving/ outside the sanctioned
+    sites (Engine.__init__/swap_weights, WeightStore.__init__) fails;
+    the sanctioned sites, reads, local variables named params, and
+    noqa'd rebinding pass; files outside serving/ are out of scope."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import lint_local
+    finally:
+        sys.path.pop(0)
+    pf = lint_local.pitfalls
+    eng = os.path.join(REPO, "distributed_training_tpu", "serving",
+                       "engine.py")
+    bad = (
+        "def hot_patch(self, new):\n"
+        "    self.params = new\n"
+        "def nudge(self, g):\n"
+        "    self.params += g\n")
+    hits = [p for p in pf.check_file_rules(eng, repo=REPO, text=bad)
+            if "DTT011" in p]
+    assert len(hits) == 2, hits
+    ok = (
+        "def __init__(self, params):\n"
+        "    self.params = params\n"
+        "def swap_weights(self, params, version):\n"
+        "    self.params = params\n"
+        "def read_only(self):\n"
+        "    params = self.params\n"
+        "    return params\n"
+        "def justified(self, new):\n"
+        "    self.params = new  # noqa: DTT011\n")
+    assert not [p for p in pf.check_file_rules(eng, repo=REPO, text=ok)
+                if "DTT011" in p]
+    # A noqa for a DIFFERENT code must not disable this rule.
+    other = ("def hot_patch(self, new):\n"
+             "    self.params = new  # noqa: E501\n")
+    assert [p for p in pf.check_file_rules(eng, repo=REPO, text=other)
+            if "DTT011" in p]
+    # WeightStore.__init__ loads the artifact's params legitimately;
+    # any other disagg function rebinding is flagged.
+    dis = os.path.join(REPO, "distributed_training_tpu", "serving",
+                       "disagg.py")
+    store = ("def __init__(self, path):\n"
+             "    self.params = {}\n"
+             "def reload(self, path):\n"
+             "    self.params = {}\n")
+    hits = [p for p in pf.check_file_rules(dis, repo=REPO, text=store)
+            if "DTT011" in p]
+    assert len(hits) == 1 and ":4:" in hits[0], hits
+    # Outside serving/ the rule does not apply (the trainer rebinds
+    # params every step by design).
+    tr = os.path.join(REPO, "distributed_training_tpu", "train",
+                      "somewhere.py")
+    assert not [p for p in pf.check_file_rules(tr, repo=REPO, text=bad)
+                if "DTT011" in p]
+    # The rule is live against the REAL tree: zero offenders today.
+    for rel, fns in pf.DTT011_ALLOWED.items():
+        assert fns, rel
+    real = [p for p in pf.check_file_rules(eng, repo=REPO)
+            if "DTT011" in p]
+    assert real == [], real

@@ -632,7 +632,7 @@ def test_serving_trace_schema_keys_pinned():
     assert TRACE_KEYS == (
         "id", "tenant", "outcome", "prompt_tokens", "new_tokens",
         "queue_wait_s", "ttft_s", "e2e_s", "prefix_hit_tokens",
-        "tokens_discarded", "spans")
+        "tokens_discarded", "spans", "weights_versions")
     assert set(SPAN_EVENTS) == {
         "queued", "admitted", "resumed", "adopted", "prefill",
         "decode", "session_retain", "finished", "preempted"}
